@@ -1,0 +1,55 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Capability surface modeled on Ray (reference: python/ray/__init__.py
+export list :175) with a TPU-first architecture: hosts and pod slices
+are first-class schedulable resources, XLA/ICI collectives replace
+NCCL, and the object store feeds JAX zero-copy.
+"""
+
+from . import exceptions
+from .api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    state_summary,
+    timeline,
+    wait,
+)
+from .actor import ActorClass, ActorHandle
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "timeline",
+    "state_summary",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "exceptions",
+    "__version__",
+]

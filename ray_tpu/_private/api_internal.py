@@ -1,0 +1,99 @@
+"""Glue between the public decorators and the CoreWorker.
+
+Option resolution mirrors the reference's option table
+(reference: python/ray/_private/ray_option_utils.py): `num_cpus`,
+`num_tpus` (the accelerator analog of num_gpus), `resources={...}`,
+`num_returns`, `max_retries`, actor `name`/`namespace`/`max_restarts`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from .. import exceptions as exc
+from ..actor import ActorClass, ActorHandle
+from ..remote_function import RemoteFunction
+from .worker import CoreWorker, global_worker
+
+
+def _require_worker() -> CoreWorker:
+    worker = global_worker()
+    if worker is None:
+        raise exc.RayTpuError(
+            "ray_tpu.init() must be called before using the API"
+        )
+    return worker
+
+
+def _flatten_args(args: tuple, kwargs: dict) -> Sequence[Any]:
+    # Kwargs ride as a trailing marker tuple; the executor re-splits.
+    if not kwargs:
+        return list(args)
+    return list(args) + [("__kwargs__", kwargs)]
+
+
+def _task_resources(options: Dict[str, Any], default_cpu: float) -> dict:
+    resources = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    num_tpus = options.get("num_tpus")
+    resources["CPU"] = float(default_cpu if num_cpus is None else num_cpus)
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    return {k: v for k, v in resources.items() if v}
+
+
+def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
+    worker = _require_worker()
+    opts = rf.task_options
+    if rf._exported_key is None:
+        rf._exported_key = worker.functions.export(rf.underlying)
+    num_returns = opts.get("num_returns", 1)
+    refs = worker.submit_task(
+        rf._exported_key,
+        _flatten_args(args, kwargs),
+        name=rf.underlying.__name__,
+        num_returns=num_returns,
+        resources=_task_resources(opts, default_cpu=1.0),
+        max_retries=opts.get("max_retries", worker.config.task_max_retries),
+    )
+    return refs[0] if num_returns == 1 else refs
+
+
+def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
+    worker = _require_worker()
+    opts = ac.actor_options
+    if ac._exported_key is None:
+        ac._exported_key = worker.functions.export(ac.underlying)
+    meta = {
+        "class_name": ac.underlying.__name__,
+        "methods": ac.method_names(),
+        "class_key": ac._exported_key,
+    }
+    actor_id = worker.create_actor(
+        ac._exported_key,
+        _flatten_args(args, kwargs),
+        class_name=ac.underlying.__name__,
+        name=opts.get("name"),
+        namespace=opts.get("namespace", "default"),
+        resources=_task_resources(opts, default_cpu=0.0),
+        max_restarts=opts.get("max_restarts", 0),
+        handle_meta=meta,
+    )
+    return ActorHandle(actor_id, meta)
+
+
+def submit_actor_method(
+    handle: ActorHandle,
+    method: str,
+    args: tuple,
+    kwargs: dict,
+    num_returns: int = 1,
+):
+    worker = _require_worker()
+    refs = worker.submit_actor_task(
+        handle.actor_id,
+        method,
+        _flatten_args(args, kwargs),
+        num_returns=num_returns,
+    )
+    return refs[0] if num_returns == 1 else refs

@@ -1,0 +1,106 @@
+"""Central runtime configuration with environment overrides.
+
+Equivalent of the reference's RAY_CONFIG macro table (reference:
+src/ray/common/ray_config_def.h:18-22 — 219 typed flags, each
+overridable via `RAY_<name>` env vars or a `_system_config` dict passed
+at init). We keep the same contract: every flag is typed, has a
+default, can be overridden by `RT_<name>` in the environment or by the
+`_system_config` dict handed to `ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+from typing import Any
+
+_ENV_PREFIX = "RT_"
+
+
+@dataclass
+class Config:
+    # ---- object store ----
+    #: Objects at or below this size are passed inline in task
+    #: specs/replies instead of the shared-memory store (reference:
+    #: max_direct_call_object_size, ray_config_def.h).
+    max_direct_call_object_size: int = 100 * 1024
+    #: Shared-memory store capacity per node (bytes). 0 = auto (30% of
+    #: system memory, like the reference's default_object_store_memory).
+    object_store_memory: int = 0
+    #: Chunk size for cross-node object transfer (reference:
+    #: object_manager_default_chunk_size = 5 MiB, ray_config_def.h:341).
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+    #: Max bytes in flight for object pulls per node.
+    object_pull_max_bytes_in_flight: int = 256 * 1024 * 1024
+    #: Seconds between object-store eviction scans.
+    object_eviction_check_interval_s: float = 1.0
+
+    # ---- scheduler ----
+    #: Beyond this fraction of node utilization the hybrid policy
+    #: spreads instead of packing (reference:
+    #: scheduler_spread_threshold, hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = 0.5
+    #: Top-k fraction of nodes considered for random placement.
+    scheduler_top_k_fraction: float = 0.2
+    #: Max worker processes kept warm per node. 0 = num_cpus.
+    worker_pool_max_idle_workers: int = 2
+    #: Seconds an idle leased worker is kept before being returned.
+    worker_lease_idle_timeout_s: float = 1.0
+    #: Hard cap on worker processes started per node. 0 = 4 * num_cpus.
+    max_workers_per_node: int = 0
+
+    # ---- fault tolerance ----
+    #: Default max retries for tasks (reference: task default 3).
+    task_max_retries: int = 3
+    #: Default max restarts for actors.
+    actor_max_restarts: int = 0
+    #: Period of node health probes from the control plane (reference:
+    #: gcs_health_check_manager.h period/threshold).
+    health_check_period_s: float = 1.0
+    #: Consecutive failed probes before a node is declared dead.
+    health_check_failure_threshold: int = 5
+    #: RPC retry backoff base/cap in seconds.
+    rpc_retry_base_s: float = 0.1
+    rpc_retry_max_s: float = 2.0
+
+    # ---- task events / observability ----
+    #: Ring-buffer length of task state events kept by the control
+    #: plane (reference: GcsTaskManager).
+    task_events_max_buffer: int = 10000
+    #: Whether workers batch task state events to the control plane.
+    task_events_enabled: bool = True
+
+    # ---- testing / chaos ----
+    #: Fault-injection spec "method=count" — drop the first `count`
+    #: RPCs with the given method name (reference: rpc_chaos.h:23-31,
+    #: env RAY_testing_rpc_failure).
+    testing_rpc_failure: str = ""
+
+    @classmethod
+    def from_env(cls, overrides: dict[str, Any] | None = None) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            env_key = _ENV_PREFIX + f.name
+            if env_key in os.environ:
+                setattr(cfg, f.name, _parse(f.type, os.environ[env_key]))
+        for key, value in (overrides or {}).items():
+            if not hasattr(cfg, key):
+                raise ValueError(f"Unknown config flag: {key}")
+            setattr(cfg, key, value)
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+def _parse(type_name: str, raw: str) -> Any:
+    if type_name in ("int",):
+        return int(raw)
+    if type_name in ("float",):
+        return float(raw)
+    if type_name in ("bool",):
+        return raw.lower() in ("1", "true", "yes")
+    if type_name in ("str",):
+        return raw
+    return json.loads(raw)

@@ -1,0 +1,204 @@
+"""Control-plane state tables (GCS equivalent).
+
+The reference's Global Control Service is a standalone server hosting
+node/actor/job/placement-group/worker/task managers over typed tables
+with pluggable storage (reference: src/ray/gcs/gcs_server/gcs_server.h,
+init order gcs_server.cc:183-233; storage src/ray/gcs/store_client/).
+
+Here the same tables live in one `ControlState` object. On a head node
+it is embedded in the node daemon and served over its RPC socket; other
+node daemons talk to it remotely — mirroring how every raylet holds a
+GcsClient. Persistence (the reference's Redis StoreClient) is a JSON
+snapshot hook, sufficient for restart-with-state-recovery semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+
+# Actor lifecycle states (reference: src/ray/design_docs/actor_states.rst).
+ACTOR_DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+ACTOR_PENDING_CREATION = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.time)
+    is_head: bool = False
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: str
+    class_name: str
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[Any] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: Optional[str] = None
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    driver_pid: int
+    start_time: float
+    end_time: Optional[float] = None
+    entrypoint: str = ""
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    name: Optional[str]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundles: List[Dict[str, float]]
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+
+
+class ControlState:
+    """All control-plane tables behind one lock.
+
+    Sub-tables mirror the reference's managers: kv (GcsKvManager),
+    nodes (GcsNodeManager), actors (GcsActorManager), jobs
+    (GcsJobManager), placement groups (GcsPlacementGroupManager), task
+    events (GcsTaskManager ring buffer).
+    """
+
+    def __init__(self, task_events_max: int = 10000):
+        self._lock = threading.RLock()
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> val
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name)
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.task_events: deque = deque(maxlen=task_events_max)
+        self._job_counter = 0
+
+    # ---- kv (function blobs, cluster config) ----
+    def kv_put(self, ns: str, key: str, value: bytes, overwrite=True) -> bool:
+        with self._lock:
+            table = self.kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return False
+            table[key] = value
+            return True
+
+    def kv_get(self, ns: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get(ns, {}).get(key)
+
+    def kv_del(self, ns: str, key: str) -> None:
+        with self._lock:
+            self.kv.get(ns, {}).pop(key, None)
+
+    def kv_keys(self, ns: str, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ---- nodes ----
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id] = info
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            if node_id in self.nodes:
+                self.nodes[node_id].last_heartbeat = time.time()
+
+    def mark_node_dead(self, node_id: NodeID) -> None:
+        with self._lock:
+            if node_id in self.nodes:
+                self.nodes[node_id].alive = False
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # ---- jobs ----
+    def next_job_id(self) -> JobID:
+        with self._lock:
+            self._job_counter += 1
+            return JobID.from_int(self._job_counter)
+
+    def add_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self.jobs[info.job_id] = info
+
+    def finish_job(self, job_id: JobID) -> None:
+        with self._lock:
+            if job_id in self.jobs:
+                self.jobs[job_id].end_time = time.time()
+
+    # ---- actors ----
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    raise ValueError(
+                        f"Actor name {info.name!r} already taken in "
+                        f"namespace {info.namespace!r}"
+                    )
+                self.named_actors[key] = info.actor_id
+            self.actors[info.actor_id] = info
+
+    def update_actor_state(self, actor_id: ActorID, state: str, **kw) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            for k, v in kw.items():
+                setattr(info, k, v)
+            if state == ACTOR_DEAD and info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+
+    def get_named_actor(self, namespace: str, name: str) -> Optional[ActorInfo]:
+        with self._lock:
+            actor_id = self.named_actors.get((namespace, name))
+            return self.actors.get(actor_id) if actor_id else None
+
+    # ---- placement groups ----
+    def add_placement_group(self, info: PlacementGroupInfo) -> None:
+        with self._lock:
+            self.placement_groups[info.pg_id] = info
+
+    # ---- task events (observability ring buffer) ----
+    def add_task_event(self, event: dict) -> None:
+        with self._lock:
+            self.task_events.append(event)
+
+    def list_task_events(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            return list(self.task_events)[-limit:]
+
+    # ---- state API snapshot ----
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": len(self.nodes),
+                "alive_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                "actors": len(self.actors),
+                "jobs": len(self.jobs),
+                "placement_groups": len(self.placement_groups),
+            }

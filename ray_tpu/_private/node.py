@@ -1,0 +1,88 @@
+"""Session bootstrap: start/stop node processes.
+
+Mirrors the reference's Node/services layer (reference:
+python/ray/_private/node.py:37, services.py:829 — spawns GCS, raylet,
+dashboard, log monitor). Here a head "session" embeds the NodeDaemon
+(raylet+GCS) in the driver process behind its Unix socket, so a bare
+`init()` needs no separate binaries; `init(address=...)` instead
+connects to a daemon started by `rt start --head` (cli.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import glob
+import os
+import tempfile
+import time
+from typing import Dict, Optional
+
+from .config import Config
+from .daemon import NodeDaemon
+from .rpc import configure_chaos
+from .worker import CoreWorker, set_global_worker
+
+
+def detect_num_tpu_chips() -> int:
+    """TPU chip count via device files (reference:
+    python/ray/_private/accelerators/tpu.py:107 — counts /dev/accel*)."""
+    chips = len(glob.glob("/dev/accel*"))
+    if chips:
+        return chips
+    if glob.glob("/dev/vfio/*"):
+        return len([p for p in glob.glob("/dev/vfio/*") if p.split("/")[-1].isdigit()])
+    return 0
+
+
+class Session:
+    def __init__(
+        self,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        system_config: Optional[dict] = None,
+        address: Optional[str] = None,
+        session_dir: Optional[str] = None,
+    ):
+        self.config = Config.from_env(system_config)
+        if self.config.testing_rpc_failure:
+            configure_chaos(self.config.testing_rpc_failure)
+        self.daemon: Optional[NodeDaemon] = None
+        if address is None:
+            self.session_dir = session_dir or tempfile.mkdtemp(
+                prefix=f"rt_session_{int(time.time())}_"
+            )
+            total = dict(resources or {})
+            total.setdefault(
+                "CPU", float(num_cpus if num_cpus is not None else os.cpu_count())
+            )
+            tpus = (
+                float(num_tpus)
+                if num_tpus is not None
+                else float(detect_num_tpu_chips())
+            )
+            if tpus:
+                total.setdefault("TPU", tpus)
+            total.setdefault("memory", float(2**34))
+            self.daemon = NodeDaemon(
+                self.session_dir, total, self.config, is_head=True
+            )
+            self.daemon.start()
+            address = self.daemon.socket_path
+        self.address = address
+        self.worker = CoreWorker(address, role="driver")
+        set_global_worker(self.worker)
+        atexit.register(self.shutdown)
+
+    def shutdown(self) -> None:
+        try:
+            atexit.unregister(self.shutdown)
+        except Exception:
+            pass
+        set_global_worker(None)
+        if self.worker is not None:
+            self.worker.shutdown()
+            self.worker = None
+        if self.daemon is not None:
+            self.daemon.shutdown()
+            self.daemon = None

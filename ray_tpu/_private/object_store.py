@@ -1,0 +1,300 @@
+"""Node-local object stores.
+
+Two tiers, mirroring the reference's split between the in-process
+memory store for small objects and the plasma shared-memory store for
+large ones (reference: src/ray/core_worker/store_provider/,
+src/ray/object_manager/plasma/store.h):
+
+* `InProcessStore` — small objects (≤ max_direct_call_object_size) live
+  in the owner process and are inlined into task specs/replies.
+
+* `SharedMemoryStore` — immutable shared-memory objects, one POSIX SHM
+  segment per object, readable zero-copy by every process on the node.
+  Plasma's mmap-arena + dlmalloc design (plasma/dlmalloc.cc) is an
+  allocation optimization we trade away for per-object segments, which
+  the kernel already refcounts; create/seal/get/delete and LRU eviction
+  semantics are preserved (plasma/object_lifecycle_manager.h,
+  eviction_policy.h).
+
+Both stores hand out `memoryview`s so deserialization is zero-copy all
+the way into numpy / `jax.numpy.asarray`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from multiprocessing import shared_memory, resource_tracker
+from typing import Dict, Optional
+
+from .ids import ObjectID
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectNotSealedError(Exception):
+    pass
+
+
+def _close_shm(shm: shared_memory.SharedMemory) -> None:
+    """Close a segment, tolerating live zero-copy views.
+
+    numpy/jax arrays deserialized from the store keep memoryview
+    exports into the mapping; releasing then raises BufferError. The
+    segment is already unlinked by callers, so we drop our handles and
+    let the pages die with the last view (avoids "Exception ignored in
+    __del__" noise at interpreter exit).
+    """
+    try:
+        shm.close()
+    except BufferError:
+        shm._buf = None  # noqa: SLF001 — disarm SharedMemory.__del__
+        shm._mmap = None  # noqa: SLF001
+        fd = getattr(shm, "_fd", -1)
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            shm._fd = -1  # noqa: SLF001
+
+
+def _unregister(shm: shared_memory.SharedMemory) -> None:
+    # Python's resource_tracker unlinks SHM segments when *any* process
+    # that attached exits, which would tear objects out from under
+    # other readers. The store owns lifetime explicitly, so opt out.
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+@dataclass
+class _Entry:
+    shm: shared_memory.SharedMemory
+    size: int
+    sealed: bool
+    created_at: float
+    pinned: int = 0  # pin count: primary copies pinned by the node
+                     # daemon are never evicted (reference:
+                     # raylet/local_object_manager.h primary pinning)
+
+
+class SharedMemoryStore:
+    """Create/seal/get over per-object shared-memory segments.
+
+    The process that calls `create` writes into the returned buffer and
+    then calls `seal`; readers in any process call `get`/`open` and map
+    the same pages. Objects are immutable after seal.
+    """
+
+    def __init__(self, node_id_hex: str, capacity: int):
+        self._prefix = f"rt_{node_id_hex[:8]}_"
+        self._capacity = capacity
+        self._used = 0
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._seal_events: Dict[ObjectID, threading.Event] = {}
+
+    # -- producer side ---------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        size = max(size, 1)
+        with self._lock:
+            if object_id in self._entries:
+                raise ValueError(f"Object {object_id} already exists")
+            if self._used + size > self._capacity:
+                self._evict(self._used + size - self._capacity)
+            if self._used + size > self._capacity:
+                raise ObjectStoreFullError(
+                    f"need {size} bytes, store has "
+                    f"{self._capacity - self._used} free of {self._capacity}"
+                )
+            shm = shared_memory.SharedMemory(
+                name=self._name(object_id), create=True, size=size
+            )
+            _unregister(shm)
+            self._entries[object_id] = _Entry(
+                shm=shm, size=size, sealed=False, created_at=time.time()
+            )
+            self._used += size
+            return shm.buf[:size]
+
+    def seal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries[object_id]
+            entry.sealed = True
+            event = self._seal_events.pop(object_id, None)
+        if event is not None:
+            event.set()
+
+    def put(self, object_id: ObjectID, data: bytes | memoryview) -> None:
+        buf = self.create(object_id, len(data))
+        buf[: len(data)] = data
+        self.seal(object_id)
+
+    # -- consumer side ---------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get(
+        self, object_id: ObjectID, timeout: Optional[float] = None
+    ) -> Optional[memoryview]:
+        """Return a zero-copy view of a sealed object, waiting if needed."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.sealed:
+                self._entries.move_to_end(object_id)  # LRU touch
+                return entry.shm.buf[: entry.size]
+            event = self._seal_events.setdefault(object_id, threading.Event())
+        while True:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return None
+            if event.wait(timeout=remaining if remaining else 0.05):
+                break
+            with self._lock:
+                entry = self._entries.get(object_id)
+                if entry is not None and entry.sealed:
+                    break
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed:
+                return None
+            return entry.shm.buf[: entry.size]
+
+    def open_remote(self, object_id: ObjectID, size: int) -> memoryview:
+        """Attach to a segment created by another process on this node."""
+        shm = shared_memory.SharedMemory(name=self._name(object_id))
+        _unregister(shm)
+        with self._lock:
+            if object_id not in self._entries:
+                self._entries[object_id] = _Entry(
+                    shm=shm, size=size, sealed=True, created_at=time.time()
+                )
+        return shm.buf[:size]
+
+    # -- lifetime --------------------------------------------------------
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._entries:
+                self._entries[object_id].pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._entries:
+                self._entries[object_id].pinned = max(
+                    0, self._entries[object_id].pinned - 1
+                )
+
+    def unlink_by_id(self, object_id: ObjectID) -> None:
+        """Unlink a segment this process never attached (the daemon
+        owns lifetime but clients create segments directly)."""
+        with self._lock:
+            if object_id in self._entries:
+                pass  # fall through to normal delete below
+            else:
+                try:
+                    shm = shared_memory.SharedMemory(
+                        name=self._name(object_id)
+                    )
+                    _unregister(shm)
+                    shm.unlink()
+                    shm.close()
+                except FileNotFoundError:
+                    pass
+                return
+        self.delete(object_id, unlink=True)
+
+    def delete(self, object_id: ObjectID, unlink: bool = True) -> None:
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is not None:
+                self._used -= entry.size
+        if entry is not None:
+            if unlink:
+                try:
+                    entry.shm.unlink()
+                except FileNotFoundError:
+                    pass
+            _close_shm(entry.shm)
+
+    def size_info(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._capacity,
+                "used": self._used,
+                "num_objects": len(self._entries),
+            }
+
+    def _evict(self, bytes_needed: int) -> None:
+        """LRU eviction of unpinned sealed objects (caller holds lock)."""
+        freed = 0
+        victims = [
+            oid
+            for oid, e in self._entries.items()
+            if e.sealed and e.pinned == 0
+        ]
+        for oid in victims:
+            if freed >= bytes_needed:
+                break
+            entry = self._entries.pop(oid)
+            freed += entry.size
+            self._used -= entry.size
+            try:
+                entry.shm.unlink()
+            except FileNotFoundError:
+                pass
+            _close_shm(entry.shm)
+
+    def _name(self, object_id: ObjectID) -> str:
+        return self._prefix + object_id.hex()
+
+    def shutdown(self, unlink: bool = True) -> None:
+        with self._lock:
+            for oid in list(self._entries):
+                self.delete(oid, unlink=unlink)
+
+
+class InProcessStore:
+    """Owner-process store for small objects (reference:
+    core_worker/store_provider/memory_store/)."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, bytes] = {}
+        self._lock = threading.Lock()
+        self._events: Dict[ObjectID, threading.Event] = {}
+
+    def put(self, object_id: ObjectID, data: bytes) -> None:
+        with self._lock:
+            self._objects[object_id] = bytes(data)
+            event = self._events.pop(object_id, None)
+        if event is not None:
+            event.set()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get(
+        self, object_id: ObjectID, timeout: Optional[float] = None
+    ) -> Optional[bytes]:
+        with self._lock:
+            if object_id in self._objects:
+                return self._objects[object_id]
+            event = self._events.setdefault(object_id, threading.Event())
+        if not event.wait(timeout=timeout):
+            return None
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)

@@ -1,0 +1,404 @@
+"""Minimal message-passing RPC over Unix domain sockets.
+
+Plays the role of the reference's gRPC wrappers (reference:
+src/ray/rpc/grpc_server.h, grpc_client.h, retryable_grpc_client.h):
+length-prefixed pickled dict messages, a threaded server dispatching to
+registered handlers, and a client with request/response correlation,
+server-push subscriptions, retry with exponential backoff, and the
+same fault-injection hook the reference exposes for chaos testing
+(rpc_chaos.h:23-31 — `RT_testing_rpc_failure="method=count"` drops the
+first `count` calls of `method`).
+
+Wire format: 8-byte big-endian length + pickled dict. Every message
+carries `_mid` (correlation id); server replies echo it; unsolicited
+pushes use `_mid = -1` and a `_push` channel name.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+_LEN = struct.Struct(">Q")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# chaos / fault injection
+# ---------------------------------------------------------------------------
+
+_chaos_lock = threading.Lock()
+_chaos_budget: Dict[str, int] = {}
+
+
+def configure_chaos(spec: str) -> None:
+    """Parse "method=count,method2=count2" fault-injection spec."""
+    with _chaos_lock:
+        _chaos_budget.clear()
+        for part in filter(None, spec.split(",")):
+            method, _, count = part.partition("=")
+            _chaos_budget[method.strip()] = int(count or 1)
+
+
+def _chaos_should_fail(method: str) -> bool:
+    with _chaos_lock:
+        left = _chaos_budget.get(method, 0)
+        if left > 0:
+            _chaos_budget[method] = left - 1
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    payload = pickle.dumps(msg, protocol=5)
+    try:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+    except (BrokenPipeError, ConnectionResetError, OSError) as e:
+        raise ConnectionLost(str(e)) from e
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n > 0:
+        try:
+            chunk = sock.recv(min(n, 1 << 20))
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class RpcServer:
+    """Threaded Unix-socket server dispatching named methods.
+
+    Handlers run on per-connection reader threads; a handler may reply
+    synchronously (return a dict) or later via the provided
+    `Connection.push` / deferred reply handle.
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._handlers: Dict[str, Callable] = {}
+        self._connections: Dict[int, "Connection"] = {}
+        self._conn_counter = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        if os.path.exists(path):
+            os.unlink(path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(128)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"rpc-accept:{path}", daemon=True
+        )
+
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def start(self) -> None:
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conn_counter += 1
+                conn = Connection(self, sock, self._conn_counter)
+                self._connections[conn.conn_id] = conn
+            threading.Thread(
+                target=conn.serve, name=f"rpc-conn-{conn.conn_id}", daemon=True
+            ).start()
+
+    def _dispatch(self, conn: "Connection", msg: dict) -> None:
+        method = msg.get("_method", "")
+        mid = msg.get("_mid")
+        handler = self._handlers.get(method)
+        if handler is None:
+            if mid:
+                conn.reply(mid, {"_error": f"no such method: {method}"})
+            return
+        try:
+            result = handler(conn, msg)
+        except Exception as e:  # noqa: BLE001 — errors propagate to caller
+            import traceback
+
+            if mid:
+                conn.reply(
+                    mid, {"_error": f"{e}\n{traceback.format_exc()}"}
+                )
+            return
+        if result is not DEFERRED and mid:
+            conn.reply(mid, result or {})
+
+    def _on_disconnect(self, conn: "Connection") -> None:
+        with self._lock:
+            self._connections.pop(conn.conn_id, None)
+        handler = self._handlers.get("_disconnect")
+        if handler is not None:
+            try:
+                handler(conn, {})
+            except Exception:
+                pass
+
+    def connections(self) -> list:
+        with self._lock:
+            return list(self._connections.values())
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self.connections():
+            conn.close()
+        if os.path.exists(self._path):
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+#: Sentinel a handler returns to indicate it will reply later via
+#: `Connection.reply(mid, ...)` (used for blocking ops like object gets).
+DEFERRED = object()
+
+
+class Connection:
+    """Server-side view of one client connection."""
+
+    def __init__(self, server: RpcServer, sock: socket.socket, conn_id: int):
+        self._server = server
+        self._sock = sock
+        self.conn_id = conn_id
+        self._send_lock = threading.Lock()
+        self.metadata: Dict[str, Any] = {}  # e.g. worker id after register
+
+    def serve(self) -> None:
+        while True:
+            msg = recv_msg(self._sock)
+            if msg is None:
+                break
+            self._server._dispatch(self, msg)
+        self._server._on_disconnect(self)
+
+    def reply(self, mid, payload: dict) -> None:
+        payload = dict(payload)
+        payload["_mid"] = mid
+        with self._send_lock:
+            try:
+                send_msg(self._sock, payload)
+            except ConnectionLost:
+                pass
+
+    def push(self, channel: str, payload: dict) -> None:
+        payload = dict(payload)
+        payload["_mid"] = -1
+        payload["_push"] = channel
+        with self._send_lock:
+            try:
+                send_msg(self._sock, payload)
+            except ConnectionLost:
+                pass
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RpcClient:
+    """Thread-safe client with correlation ids, pushes, and retries."""
+
+    def __init__(
+        self,
+        path: str,
+        push_handler: Optional[Callable[[str, dict], None]] = None,
+        connect_timeout: float = 10.0,
+    ):
+        self._path = path
+        self._push_handler = push_handler
+        self._sock = self._connect(connect_timeout)
+        self._mid = 0
+        self._lock = threading.Lock()
+        self._pending: Dict[int, threading.Event] = {}
+        self._replies: Dict[int, dict] = {}
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"rpc-client:{path}", daemon=True
+        )
+        self._reader.start()
+
+    def _connect(self, timeout: float) -> socket.socket:
+        deadline = time.time() + timeout
+        last_err: Exception | None = None
+        while time.time() < deadline:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                sock.connect(self._path)
+                return sock
+            except (FileNotFoundError, ConnectionRefusedError) as e:
+                last_err = e
+                sock.close()
+                time.sleep(0.05)
+        raise ConnectionLost(f"cannot connect to {self._path}: {last_err}")
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            msg = recv_msg(self._sock)
+            if msg is None:
+                break
+            mid = msg.get("_mid")
+            if mid == -1:
+                if self._push_handler is not None:
+                    try:
+                        self._push_handler(msg.get("_push", ""), msg)
+                    except Exception:
+                        pass
+                continue
+            with self._lock:
+                event = self._pending.pop(mid, None)
+                if event is not None:
+                    self._replies[mid] = msg
+            if event is not None:
+                event.set()
+        # Connection lost: wake all waiters with an error.
+        with self._lock:
+            for mid, event in self._pending.items():
+                self._replies[mid] = {"_error": "__connection_lost__"}
+                event.set()
+            self._pending.clear()
+
+    def call(
+        self,
+        method: str,
+        timeout: Optional[float] = None,
+        retries: int = 0,
+        **kwargs,
+    ) -> dict:
+        """Synchronous call; raises RpcError on handler error."""
+        attempt = 0
+        backoff = 0.1
+        while True:
+            if _chaos_should_fail(method):
+                reply = {"_error": "__chaos_injected_failure__"}
+            else:
+                reply = self._call_once(method, timeout, kwargs)
+            err = reply.get("_error")
+            if err is None:
+                return reply
+            if attempt < retries and err in (
+                "__chaos_injected_failure__",
+                "__connection_lost__",
+                "__timeout__",
+            ):
+                attempt += 1
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                if err == "__connection_lost__":
+                    self._reconnect()
+                continue
+            raise RpcError(f"{method}: {err}")
+
+    def _call_once(self, method, timeout, kwargs) -> dict:
+        with self._lock:
+            if self._closed:
+                return {"_error": "__connection_lost__"}
+            self._mid += 1
+            mid = self._mid
+            event = threading.Event()
+            self._pending[mid] = event
+        msg = dict(kwargs)
+        msg["_method"] = method
+        msg["_mid"] = mid
+        try:
+            send_msg(self._sock, msg)
+        except ConnectionLost:
+            with self._lock:
+                self._pending.pop(mid, None)
+            return {"_error": "__connection_lost__"}
+        if not event.wait(timeout=timeout):
+            with self._lock:
+                self._pending.pop(mid, None)
+            return {"_error": "__timeout__"}
+        with self._lock:
+            return self._replies.pop(mid)
+
+    def notify(self, method: str, **kwargs) -> None:
+        """Fire-and-forget message (no reply expected)."""
+        msg = dict(kwargs)
+        msg["_method"] = method
+        msg["_mid"] = 0
+        try:
+            send_msg(self._sock, msg)
+        except ConnectionLost:
+            pass
+
+    def _reconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._connect(10.0)
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True
+        )
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

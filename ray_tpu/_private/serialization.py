@@ -1,0 +1,109 @@
+"""Object serialization with zero-copy buffer support.
+
+Mirrors the contract of the reference's SerializationContext
+(reference: python/ray/_private/serialization.py:122 — cloudpickle with
+out-of-band pickle-protocol-5 buffers so large numpy/arrow payloads are
+written/read from plasma without copies).
+
+Here the on-wire layout is:
+
+    [8-byte header len][pickled header][buffer 0][buffer 1]...
+
+The header holds the protocol-5 in-band pickle bytes plus per-buffer
+(offset, length, alignment) metadata. Writing into a shared-memory
+object therefore needs exactly one pass over the buffers, and reading
+reconstructs numpy/jax arrays as views over the mapped memory —
+zero-copy, which is what lets the store feed `jax.numpy.asarray` /
+dlpack without a host copy (SURVEY.md §7 hard part 3).
+
+ObjectRefs embedded inside values are recorded in the header so the
+owner can track borrowed references (reference:
+core_worker/reference_count.h borrower protocol).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from dataclasses import dataclass, field
+from typing import Any
+
+_ALIGN = 64  # TPU-friendly alignment for zero-copy into XLA.
+
+
+@dataclass
+class SerializedObject:
+    """A value serialized into header bytes + out-of-band buffers."""
+
+    inband: bytes
+    buffers: list[memoryview] = field(default_factory=list)
+
+    def total_size(self) -> int:
+        size = 8 + len(self._header())
+        for buf in self.buffers:
+            size = _align_up(size)
+            size += buf.nbytes
+        return size
+
+    def _header(self) -> bytes:
+        return pickle.dumps(
+            {
+                "inband": self.inband,
+                "nbytes": [buf.nbytes for buf in self.buffers],
+            },
+            protocol=5,
+        )
+
+    def write_to(self, target: memoryview) -> int:
+        """Write the full wire format into `target`; returns bytes used."""
+        header = self._header()
+        struct.pack_into(">Q", target, 0, len(header))
+        target[8 : 8 + len(header)] = header
+        cursor = 8 + len(header)
+        for buf in self.buffers:
+            cursor = _align_up(cursor)
+            flat = buf.cast("B") if buf.ndim != 1 or buf.format != "B" else buf
+            target[cursor : cursor + flat.nbytes] = flat
+            cursor += flat.nbytes
+        return cursor
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size())
+        used = self.write_to(memoryview(out))
+        return bytes(out[:used])
+
+
+def _align_up(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class SerializationContext:
+    """Pickles values with out-of-band protocol-5 buffers.
+
+    ObjectRefs embedded in values survive the trip via
+    ObjectRef.__reduce__, which re-attaches them to the receiving
+    process's worker and notifies the owner of the borrow."""
+
+    def __init__(self, ref_class: type | None = None):
+        self._ref_class = ref_class
+
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: list[pickle.PickleBuffer] = []
+        inband = pickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
+        return SerializedObject(
+            inband=inband, buffers=[b.raw() for b in buffers]
+        )
+
+    def deserialize(self, data: memoryview | bytes) -> Any:
+        view = memoryview(data)
+        (header_len,) = struct.unpack_from(">Q", view, 0)
+        header = pickle.loads(bytes(view[8 : 8 + header_len]))
+        cursor = 8 + header_len
+        buffers = []
+        for nbytes in header["nbytes"]:
+            cursor = _align_up(cursor)
+            buffers.append(view[cursor : cursor + nbytes])
+            cursor += nbytes
+        return pickle.loads(header["inband"], buffers=buffers)

@@ -1,0 +1,451 @@
+"""CoreWorker — the per-process runtime for drivers and workers.
+
+Plays the role of the reference's C++ CoreWorker (reference:
+src/ray/core_worker/core_worker.h:162 — SubmitTask, CreateActor:876,
+SubmitActorTask:930, Put:462, Get:646, Wait:685 — bound into Python via
+python/ray/_raylet.pyx:2949). One instance per process; drivers use the
+submit/get surface, workers additionally run the task execution loop
+(reference: CoreWorkerProcess::RunTaskExecutionLoop,
+core_worker_process.h:98).
+
+Differences from the reference by design: small objects and task specs
+flow through the node daemon instead of worker-to-worker gRPC (single
+socket hop on-node), while large objects go straight into shared
+memory and only seal notifications hit the daemon.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import exceptions as exc
+from ..object_ref import ObjectRef
+from .config import Config
+from .function_manager import FunctionManager
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .object_store import SharedMemoryStore
+from .rpc import RpcClient, RpcError
+from .serialization import SerializationContext
+from .task_spec import (
+    make_error_payload,
+    make_exception_payload,
+    raise_from_payload,
+)
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+#: Marker used to ship kwargs as a trailing positional arg (specs carry
+#: a flat arg list; see api_internal._flatten_args).
+KWARGS_MARKER = "__kwargs__"
+
+
+def _split_kwargs(flat):
+    if (
+        flat
+        and isinstance(flat[-1], tuple)
+        and len(flat[-1]) == 2
+        and flat[-1][0] == KWARGS_MARKER
+    ):
+        return list(flat[:-1]), dict(flat[-1][1])
+    return list(flat), {}
+
+
+def global_worker() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(worker: Optional["CoreWorker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = worker
+
+
+class _TaskContext(threading.local):
+    """Per-thread submission context. Each driver thread gets its own
+    base task id so concurrent threads can't derive colliding task/put
+    ids (the reference gives non-main threads random TaskIDs too)."""
+
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.thread_base_id: TaskID = TaskID.from_random()
+        self.put_index = 0
+        self.submit_index = 0
+
+
+class CoreWorker:
+    def __init__(self, socket_path: str, role: str = "driver"):
+        self.role = role
+        # Execution state must exist before the RPC client starts its
+        # reader thread: the daemon may push execute_task immediately
+        # after (even before) the register reply.
+        self._task_queue: "queue.Queue[dict]" = queue.Queue()
+        self._actor_instance: Any = None
+        self._actor_id: Optional[ActorID] = None
+        self._running = True
+        self._client = RpcClient(socket_path, push_handler=self._on_push)
+        reply = self._client.call(
+            "register_client",
+            role=role,
+            pid=os.getpid(),
+            is_tpu=os.environ.get("RT_WORKER_TPU") == "1",
+        )
+        self.node_id = NodeID(reply["node_id"])
+        self.config = Config(**reply["config"])
+        if role == "driver":
+            self.job_id = JobID(reply["job_id"])
+            self.worker_id = WorkerID.from_random()
+        else:
+            self.job_id = JobID.from_int(0)
+            self.worker_id = WorkerID(reply["worker_id"])
+        self.store = SharedMemoryStore(
+            self.node_id.hex(), reply["store_capacity"]
+        )
+        self.serialization = SerializationContext(ref_class=ObjectRef)
+        self.functions = FunctionManager(self._client)
+        self._ctx = _TaskContext()
+        self._ref_counts: Dict[ObjectID, int] = {}
+        self._ref_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # reference counting (local handle counts -> daemon refcount)
+    # ------------------------------------------------------------------
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            self._ref_counts[oid] = self._ref_counts.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        if not self._running:
+            return
+        with self._ref_lock:
+            count = self._ref_counts.get(oid, 0) - 1
+            if count <= 0:
+                self._ref_counts.pop(oid, None)
+                notify = True
+            else:
+                self._ref_counts[oid] = count
+                notify = False
+        if notify:
+            try:
+                self._client.notify("del_ref", oids=[oid.binary()])
+            except Exception:
+                pass
+
+    def notify_borrowed_ref(self, oid: ObjectID) -> None:
+        self._client.notify("add_ref", oids=[oid.binary()])
+
+    # ------------------------------------------------------------------
+    # ids
+    # ------------------------------------------------------------------
+    def _current_task_id(self) -> TaskID:
+        return self._ctx.task_id or self._ctx.thread_base_id
+
+    def _next_task_id(self) -> TaskID:
+        self._ctx.submit_index += 1
+        return TaskID.for_task(
+            self.job_id, self._current_task_id(), self._ctx.submit_index
+        )
+
+    def _next_put_id(self) -> ObjectID:
+        self._ctx.put_index += 1
+        return ObjectID.for_put(self._current_task_id(), self._ctx.put_index)
+
+    # ------------------------------------------------------------------
+    # object plane
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = self._next_put_id()
+        self.put_object(oid, value)
+        return ObjectRef(oid, owner=self)
+
+    def put_object(self, oid: ObjectID, value: Any) -> Tuple[str, Any]:
+        """Serialize and store; returns ("inline", bytes) or ("shm", size)."""
+        serialized = self.serialization.serialize(value)
+        size = serialized.total_size()
+        if size <= self.config.max_direct_call_object_size:
+            data = serialized.to_bytes()
+            self._client.call("put_inline", oid=oid.binary(), data=data)
+            return ("inline", data)
+        buf = self.store.create(oid, size)
+        used = serialized.write_to(buf)
+        self.store.seal(oid)
+        self._client.call("object_sealed", oid=oid.binary(), size=used)
+        return ("shm", used)
+
+    def get(
+        self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
+    ) -> List[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {ref}"
+                )
+            out.append(self._get_one(ref.id(), remaining))
+        return out
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        try:
+            reply = self._client.call(
+                "get_object", oid=oid.binary(), timeout=timeout
+            )
+        except RpcError as e:
+            if "__timeout__" in str(e):
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {oid}"
+                ) from None
+            raise
+        if "error" in reply and reply["error"] is not None:
+            raise_from_payload(reply["error"])
+        if reply.get("inline") is not None:
+            return self.serialization.deserialize(reply["inline"])
+        size = reply["shm_size"]
+        view = self.store.get(oid, timeout=0.001)
+        if view is None:
+            view = self.store.open_remote(oid, size)
+        # Sealed objects are immutable (plasma semantics): readers get
+        # read-only views, so zero-copy numpy arrays can't corrupt them.
+        return self.serialization.deserialize(view[:size].toreadonly())
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        by_id = {r.binary(): r for r in refs}
+        reply = self._client.call(
+            "wait_objects",
+            oids=[r.binary() for r in refs],
+            num_returns=num_returns,
+            wait_timeout=timeout,
+            timeout=None if timeout is None else timeout + 10.0,
+        )
+        ready = [by_id[b] for b in reply["ready"] if b in by_id]
+        remaining = [by_id[b] for b in reply["remaining"] if b in by_id]
+        return ready, remaining
+
+    # ------------------------------------------------------------------
+    # task submission
+    # ------------------------------------------------------------------
+    def _serialize_args(self, args: Sequence[Any]) -> List[tuple]:
+        out = []
+        for arg in args:
+            if isinstance(arg, ObjectRef):
+                out.append(("ref", arg.binary()))
+                continue
+            serialized = self.serialization.serialize(arg)
+            size = serialized.total_size()
+            if size <= self.config.max_direct_call_object_size:
+                out.append(("inline", serialized.to_bytes()))
+            else:
+                # Large plain arg: promoted to a put + ref (reference:
+                # DependencyResolver inlining threshold).
+                oid = self._next_put_id()
+                buf = self.store.create(oid, size)
+                used = serialized.write_to(buf)
+                self.store.seal(oid)
+                self._client.call(
+                    "object_sealed", oid=oid.binary(), size=used
+                )
+                out.append(("ref", oid.binary()))
+        return out
+
+    def submit_task(
+        self,
+        func_key: str,
+        args: Sequence[Any],
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = self._next_task_id()
+        returns = [
+            ObjectID.for_return(task_id, i + 1) for i in range(num_returns)
+        ]
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "kind": "normal",
+            "name": name,
+            "function_key": func_key,
+            "args": self._serialize_args(args),
+            "returns": [r.binary() for r in returns],
+            "resources": resources or {"CPU": 1.0},
+            "max_retries": max_retries,
+        }
+        self._client.call("submit_task", spec=spec)
+        return [ObjectRef(r, owner=self) for r in returns]
+
+    def create_actor(
+        self,
+        class_key: str,
+        args: Sequence[Any],
+        class_name: str,
+        name: Optional[str] = None,
+        namespace: str = "default",
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        handle_meta: Optional[dict] = None,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "kind": "actor_creation",
+            "name": name,
+            "namespace": namespace,
+            "class_name": class_name,
+            "function_key": class_key,
+            "args": self._serialize_args(args),
+            "returns": [ObjectID.for_return(task_id, 1).binary()],
+            "resources": resources or {"CPU": 1.0},
+            "actor_id": actor_id.binary(),
+            "max_restarts": max_restarts,
+            "handle_meta": handle_meta,
+        }
+        self._client.call("create_actor", spec=spec)
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method: str,
+        args: Sequence[Any],
+        num_returns: int = 1,
+        max_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = self._next_task_id()
+        returns = [
+            ObjectID.for_return(task_id, i + 1) for i in range(num_returns)
+        ]
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "kind": "actor_task",
+            "name": method,
+            "method": method,
+            "function_key": "",
+            "args": self._serialize_args(args),
+            "returns": [r.binary() for r in returns],
+            "resources": {},
+            "actor_id": actor_id.binary(),
+            "max_retries": max_retries,
+        }
+        self._client.call("submit_actor_task", spec=spec)
+        return [ObjectRef(r, owner=self) for r in returns]
+
+    # ------------------------------------------------------------------
+    # misc API
+    # ------------------------------------------------------------------
+    def call(self, method: str, **kwargs) -> dict:
+        return self._client.call(method, **kwargs)
+
+    def notify(self, method: str, **kwargs) -> None:
+        self._client.notify(method, **kwargs)
+
+    # ------------------------------------------------------------------
+    # worker-role execution loop
+    # ------------------------------------------------------------------
+    def _on_push(self, channel: str, msg: dict) -> None:
+        if channel == "execute_task":
+            self._task_queue.put(msg["spec"])
+        elif channel == "exit":
+            self._running = False
+            self._task_queue.put(None)
+
+    def run_task_loop(self) -> None:
+        """Blocking execution loop (reference:
+        CoreWorkerProcess::RunTaskExecutionLoop)."""
+        while self._running:
+            spec = self._task_queue.get()
+            if spec is None:
+                return
+            self._execute(spec)
+
+    def _execute(self, spec: dict) -> None:
+        task_id = TaskID(spec["task_id"])
+        self._ctx.task_id = task_id
+        self._ctx.put_index = 0
+        self._ctx.submit_index = 0
+        self.job_id = JobID(spec["job_id"])
+        try:
+            args, kwargs = _split_kwargs(self._deserialize_args(spec["args"]))
+            kind = spec["kind"]
+            if kind == "actor_creation":
+                cls = self.functions.fetch(spec["function_key"])
+                self._actor_instance = cls(*args, **kwargs)
+                self._actor_id = ActorID(spec["actor_id"])
+                results = [None]
+            elif kind == "actor_task":
+                if self._actor_instance is None:
+                    raise exc.ActorDiedError("actor instance missing")
+                method = getattr(self._actor_instance, spec["method"])
+                value = method(*args, **kwargs)
+                results = self._split_returns(value, len(spec["returns"]))
+            else:
+                func = self.functions.fetch(spec["function_key"])
+                value = func(*args, **kwargs)
+                results = self._split_returns(value, len(spec["returns"]))
+        except BaseException as e:  # noqa: BLE001 — any task failure
+            payload = make_exception_payload(e)
+            self._client.notify(
+                "task_done",
+                task_id=spec["task_id"],
+                error=payload,
+                system_error=False,
+            )
+            return
+        finally:
+            self._ctx.task_id = None
+        try:
+            for oid_bytes, value in zip(spec["returns"], results):
+                self.put_object(ObjectID(oid_bytes), value)
+        except BaseException as e:  # noqa: BLE001
+            self._client.notify(
+                "task_done",
+                task_id=spec["task_id"],
+                error=make_error_payload(
+                    "TaskError", f"failed to store results: {e!r}"
+                ),
+                system_error=False,
+            )
+            return
+        self._client.notify("task_done", task_id=spec["task_id"], error=None)
+
+    def _deserialize_args(self, wire_args: List[tuple]) -> List[Any]:
+        args = []
+        for kind, payload in wire_args:
+            if kind == "inline":
+                args.append(self.serialization.deserialize(payload))
+            else:
+                args.append(self._get_one(ObjectID(payload), timeout=None))
+        return args
+
+    @staticmethod
+    def _split_returns(value: Any, num_returns: int) -> List[Any]:  # noqa: D102
+        if num_returns == 1:
+            return [value]
+        if not isinstance(value, (tuple, list)) or len(value) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{type(value).__name__}"
+            )
+        return list(value)
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._running = False
+        try:
+            self._client.close()
+        except Exception:
+            pass
+        self.store.shutdown(unlink=False)

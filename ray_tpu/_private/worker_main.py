@@ -1,0 +1,24 @@
+"""Worker process entrypoint (reference:
+python/ray/_private/workers/default_worker.py — connects the embedded
+core worker and runs the task execution loop)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    socket_path = os.environ["RT_SOCKET"]
+    from .worker import CoreWorker, set_global_worker
+
+    worker = CoreWorker(socket_path, role="worker")
+    set_global_worker(worker)
+    try:
+        worker.run_task_loop()
+    finally:
+        worker.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Actor classes and handles (reference: python/ray/actor.py —
+ActorClass._remote:890 registers with the control plane and submits the
+creation task; ActorMethod._remote:314 submits ordered actor tasks;
+handles are serializable and resolve through the actor table)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ._private.ids import ActorID
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        self._exported_key: Optional[str] = None
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actors cannot be instantiated directly; use "
+            f"{self._cls.__name__}.remote()."
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(overrides)
+        clone = ActorClass(self._cls, merged)
+        clone._exported_key = self._exported_key
+        return clone
+
+    def remote(self, *args, **kwargs) -> "ActorHandle":
+        from ._private.api_internal import create_actor
+
+        return create_actor(self, args, kwargs)
+
+    @property
+    def underlying(self) -> type:
+        return self._cls
+
+    @property
+    def actor_options(self) -> Dict[str, Any]:
+        return self._options
+
+    def method_names(self) -> list:
+        return [
+            name
+            for name in dir(self._cls)
+            if callable(getattr(self._cls, name)) and not name.startswith("__")
+        ]
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ._private.api_internal import submit_actor_method
+
+        return submit_actor_method(
+            self._handle, self._name, args, kwargs, self._num_returns
+        )
+
+
+class ActorHandle:
+    """Serializable reference to a live actor."""
+
+    def __init__(self, actor_id: ActorID, meta: Dict[str, Any]):
+        self._actor_id = actor_id
+        self._meta = meta  # {"class_name", "methods": [...]}
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        methods = self._meta.get("methods")
+        if methods is not None and name not in methods:
+            raise AttributeError(
+                f"Actor {self._meta.get('class_name', '?')} has no "
+                f"method {name!r}"
+            )
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return (
+            f"ActorHandle({self._meta.get('class_name', '?')}, "
+            f"{self._actor_id.hex()})"
+        )
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._meta))

@@ -1,0 +1,158 @@
+"""Public API surface (reference: python/ray/_private/worker.py —
+init:1270, get:2663, put:2799, wait:2864, get_actor:3010, kill:3045,
+cancel:3076, remote:3253; exports python/ray/__init__.py:175)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import exceptions as exc
+from ._private.ids import ActorID
+from ._private.node import Session
+from ._private.worker import global_worker
+from .actor import ActorClass, ActorHandle
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+
+_session: Optional[Session] = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[dict] = None,
+) -> Session:
+    """Start (or connect to) a cluster and register this process as a
+    driver."""
+    global _session
+    if _session is not None:
+        if ignore_reinit_error:
+            return _session
+        raise exc.RayTpuError(
+            "ray_tpu.init() already called; pass ignore_reinit_error=True "
+            "or call shutdown() first."
+        )
+    _session = Session(
+        num_cpus=num_cpus,
+        num_tpus=num_tpus,
+        resources=resources,
+        system_config=_system_config,
+        address=address,
+    )
+    return _session
+
+
+def shutdown() -> None:
+    global _session
+    if _session is not None:
+        _session.shutdown()
+        _session = None
+
+
+def is_initialized() -> bool:
+    return _session is not None
+
+
+def _worker():
+    worker = global_worker()
+    if worker is None:
+        raise exc.RayTpuError("ray_tpu.init() has not been called")
+    return worker
+
+
+def remote(*args, **options):
+    """Decorator turning a function into a RemoteFunction or a class
+    into an ActorClass. Supports bare `@remote` and
+    `@remote(num_cpus=..., num_tpus=..., resources=..., num_returns=...,
+    max_retries=..., name=..., max_restarts=...)`."""
+    if len(args) == 1 and not options and callable(args[0]):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("remote() takes keyword options only")
+
+    def wrapper(obj):
+        return _make_remote(obj, options)
+
+    return wrapper
+
+
+def _make_remote(obj, options):
+    if isinstance(obj, type):
+        return ActorClass(obj, options)
+    return RemoteFunction(obj, options)
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker().put(value)
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+) -> Any:
+    worker = _worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    return worker.get(list(refs), timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _worker().call(
+        "kill_actor",
+        actor_id=actor.actor_id.binary(),
+        no_restart=no_restart,
+    )
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    _worker().call("cancel_task", task_id=ref.id().task_id().binary())
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    reply = _worker().call(
+        "get_named_actor", name=name, namespace=namespace
+    )
+    if not reply.get("found"):
+        raise ValueError(f"Actor {name!r} not found in namespace {namespace!r}")
+    return ActorHandle(ActorID(reply["actor_id"]), reply["handle_meta"] or {})
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _worker().call("cluster_resources")["resources"]
+
+
+def available_resources() -> Dict[str, float]:
+    return _worker().call("available_resources")["resources"]
+
+
+def nodes() -> List[dict]:
+    return _worker().call("list_nodes")["nodes"]
+
+
+def timeline() -> List[dict]:
+    """Task state-transition events (reference: GcsTaskManager ring
+    buffer serving `ray.timeline` / the state API)."""
+    return _worker().call("list_task_events")["events"]
+
+
+def state_summary() -> dict:
+    return _worker().call("state_summary")["summary"]

@@ -1,0 +1,229 @@
+"""Llama-family decoder — the flagship model (BASELINE.md north star:
+Llama-2-7B pretraining).
+
+TPU-first design choices:
+  * pure-functional params pytree (no module system) so pjit/GSPMD see
+    plain arrays with logical-axis annotations (parallel/sharding.py);
+  * layers stacked on a leading axis and iterated with `lax.scan` —
+    one layer trace instead of n_layers, keeping XLA compile time flat;
+  * `jax.checkpoint` around each layer (rematerialization) so HBM
+    holds one layer's activations during backward;
+  * attention via the Pallas flash kernel (ops/attention.py), ring
+    attention (ops/ring_attention.py) when the sequence is sharded
+    over `sp`;
+  * bfloat16 params/activations, f32 logits for the softmax-xent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention, mha_reference, repeat_kv
+from ..ops.norms import apply_rotary, rms_norm, rotary_embedding, swiglu
+from ..ops.ring_attention import ring_attention
+from ..parallel.sharding import Annotated, annotate
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    intermediate: int = 11008
+    rope_theta: float = 10000.0
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | reference | ring
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        embed = self.vocab_size * self.dim
+        per_layer = (
+            self.dim * self.n_heads * self.head_dim  # wq
+            + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * self.dim  # wo
+            + 3 * self.dim * self.intermediate  # w1, w2, w3
+            + 2 * self.dim  # norms
+        )
+        return embed * 2 + self.n_layers * per_layer + self.dim
+
+    # ---- presets ----
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+            intermediate=128, max_seq_len=128, dtype=jnp.float32, **kw
+        )
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        """reference parity target: Llama-2-7B (BASELINE.json configs)."""
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, intermediate=14336, rope_theta=500000.0,
+            max_seq_len=8192, **kw
+        )
+
+    @staticmethod
+    def bench_410m(**kw) -> "LlamaConfig":
+        """GPT-medium-scale config for single-chip benchmarking."""
+        return LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
+            n_kv_heads=16, intermediate=2816, max_seq_len=2048, **kw
+        )
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Random initialization, layers stacked on axis 0."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    dt = cfg.dtype
+    hd = cfg.head_dim
+
+    def norm_init(key, fan_in, shape):
+        return (
+            jax.random.normal(key, shape, jnp.float32)
+            * (1.0 / math.sqrt(fan_in))
+        ).astype(dt)
+
+    keys = jax.random.split(k_layers, 7)
+    L = cfg.n_layers
+    layers = {
+        "wq": norm_init(keys[0], cfg.dim, (L, cfg.dim, cfg.n_heads * hd)),
+        "wk": norm_init(keys[1], cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
+        "wv": norm_init(keys[2], cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
+        "wo": norm_init(keys[3], cfg.n_heads * hd, (L, cfg.n_heads * hd, cfg.dim)),
+        "w1": norm_init(keys[4], cfg.dim, (L, cfg.dim, cfg.intermediate)),
+        "w3": norm_init(keys[5], cfg.dim, (L, cfg.dim, cfg.intermediate)),
+        "w2": norm_init(keys[6], cfg.intermediate, (L, cfg.intermediate, cfg.dim)),
+        "attn_norm": jnp.ones((L, cfg.dim), dt),
+        "mlp_norm": jnp.ones((L, cfg.dim), dt),
+    }
+    return {
+        "embed": norm_init(k_embed, cfg.dim, (cfg.vocab_size, cfg.dim)),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), dt),
+        "lm_head": norm_init(k_out, cfg.dim, (cfg.dim, cfg.vocab_size)),
+    }
+
+
+def param_annotations(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Logical-axis annotations matching init_params' tree: GSPMD maps
+    these through PARAM_RULES (fsdp shards embed dims, tp shards
+    heads/mlp/vocab)."""
+    return {
+        "embed": annotate("vocab", "embed"),
+        "layers": {
+            "wq": annotate("layers", "embed", "heads"),
+            "wk": annotate("layers", "embed", "kv_heads"),
+            "wv": annotate("layers", "embed", "kv_heads"),
+            "wo": annotate("layers", "heads", "embed"),
+            "w1": annotate("layers", "embed", "mlp"),
+            "w3": annotate("layers", "embed", "mlp"),
+            "w2": annotate("layers", "mlp", "embed"),
+            "attn_norm": annotate("layers", None),
+            "mlp_norm": annotate("layers", None),
+        },
+        "final_norm": annotate(None),
+        "lm_head": annotate("embed", "vocab"),
+    }
+
+
+def _attention(cfg: LlamaConfig, q, k, v, sp_axis: Optional[str]):
+    k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+    v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
+    if cfg.attention == "ring" and sp_axis is not None:
+        return ring_attention(q, k, v, sp_axis, causal=True)
+    if cfg.attention == "flash":
+        return flash_attention(q, k, v, causal=True)
+    return mha_reference(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None):
+    """One decoder block. x: [batch, seq, dim]."""
+    b, t, _ = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"])
+    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    attn = _attention(cfg, q, k, v, sp_axis)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
+    x = x + attn @ layer["wo"]
+    h = rms_norm(x, layer["mlp_norm"])
+    x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+    return x
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    sp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Token ids [batch, seq] → logits [batch, seq, vocab] (f32).
+
+    With sequence parallelism, `tokens` is the local seq shard and
+    `positions` carries its global positions.
+    """
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, layer):
+        return _layer(cfg, x, layer, cos, sin, sp_axis), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    sp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Mean next-token cross-entropy. `targets` < 0 are masked out."""
+    logits = forward(
+        params, tokens, cfg, positions=positions, sp_axis=sp_axis
+    )
+    mask = (targets >= 0).astype(jnp.float32)
+    safe_targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token (fwd+bwd), standard 6N + attention term —
+    used for MFU accounting in bench.py."""
+    n = cfg.num_params()
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len  # causal factor 1/2 applied
+    return 6.0 * n + attn / 2

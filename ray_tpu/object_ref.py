@@ -1,0 +1,95 @@
+"""ObjectRef — a future naming an immutable object in the cluster.
+
+Mirrors the reference's ObjectRef (reference: python/ray/_raylet.pyx:269
+ObjectRef class): holds the binary object id, supports `get`-via-API,
+equality/hashing by id, and releases its reference on garbage
+collection so the owner can free the object (reference:
+core_worker/reference_count.h owner-based refcounting).
+"""
+
+from __future__ import annotations
+
+from ._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner=None, skip_adding_ref=False):
+        self._id = object_id
+        self._owner = owner
+        if owner is not None and not skip_adding_ref:
+            owner.add_local_ref(object_id)
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        owner = self._owner
+        if owner is not None:
+            try:
+                owner.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Refs serialized into task args / object values re-attach to
+        # the receiving process's worker on deserialization.
+        return (_deserialize_ref, (self._id.binary(),))
+
+    # `await ref` support for async drivers.
+    def __await__(self):
+        from . import api
+
+        result = yield from _async_get(self).__await__()
+        return result
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+
+        from . import api
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(api.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+
+async def _async_get(ref: ObjectRef):
+    import asyncio
+
+    return await asyncio.wrap_future(ref.future())
+
+
+def _deserialize_ref(binary: bytes) -> ObjectRef:
+    from ._private.worker import global_worker
+
+    oid = ObjectID(binary)
+    worker = global_worker()
+    if worker is not None:
+        worker.notify_borrowed_ref(oid)
+        return ObjectRef(oid, owner=worker)
+    return ObjectRef(oid)

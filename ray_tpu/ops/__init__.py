@@ -1,0 +1,18 @@
+"""TPU compute ops: fused attention kernels, ring/Ulysses sequence
+parallelism, norms, and rotary embeddings."""
+
+from .attention import flash_attention, mha_reference, repeat_kv
+from .norms import apply_rotary, rms_norm, rotary_embedding, swiglu
+from .ring_attention import ring_attention, ulysses_attention
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "repeat_kv",
+    "rms_norm",
+    "rotary_embedding",
+    "apply_rotary",
+    "swiglu",
+    "ring_attention",
+    "ulysses_attention",
+]

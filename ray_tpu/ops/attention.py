@@ -1,0 +1,419 @@
+"""Attention ops: reference MHA and a Pallas TPU flash-attention kernel.
+
+The reference framework has no fused attention of its own (it defers to
+torch); for the TPU build this kernel is the MFU-critical op
+(SURVEY.md §7 hard part 4). Design follows the standard TPU flash
+pattern: sequential grid over KV blocks with online-softmax state in
+VMEM scratch, f32 accumulation, causal block skipping, and a custom
+VJP whose backward is two Pallas kernels (dq and dk/dv passes).
+
+Layout: [batch, heads, seq, head_dim] with head_dim padded to 128
+(MXU lane width). GQA is handled above this op by repeating KV heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; import lazily so CPU tests work.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret() -> bool:
+    # Off-TPU the kernels run in Pallas interpreter mode, which is how
+    # CI validates them numerically without hardware.
+    return jax.default_backend() in ("cpu",)
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Readable O(T^2)-memory attention; the numerical ground truth
+    for the kernels and the CPU-test fallback."""
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool), k=t_k - t_q)
+        logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(v.dtype), v
+    ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, out_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip fully-masked KV blocks (q rows all before kv cols).
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            ) + qi * block_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            ) + ki * block_k
+            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_ref[:] / l_safe).astype(out_ref.dtype)
+        # lse rides in an 8-sublane layout (TPU block shapes need the
+        # second-to-last dim divisible by 8).
+        row = m_ref[:, 0] + jnp.log(l_safe[:, 0])  # [bq]
+        lse_ref[0] = jnp.broadcast_to(row[None, :], lse_ref.shape[1:])
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    nq = pl.cdiv(t, block_q)
+    nk = pl.cdiv(tk, block_k)
+    grid = (bh, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0][:, None]  # [bq, 1]
+        delta = delta_ref[0][0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            ) + qi * block_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            ) + ki * block_k
+            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0][:, None]
+        delta = delta_ref[0][0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            ) + qi * block_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            ) + ki * block_k
+            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # [bq, bk]
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    nq = pl.cdiv(t, block_q)
+    nk = pl.cdiv(tk, block_k)
+    delta = jnp.sum(
+        out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )  # [bh, t]
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu", "gpu") and pltpu is not None
+    except Exception:
+        return False
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, residuals, do):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do, scale, causal, block_q, block_k
+    )
+    return dq, dk, dv
+
+
+_flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    force_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention: Pallas kernel on TPU, reference math elsewhere.
+
+    q/k/v: [batch, heads, seq, head_dim]. head_dim should be a
+    multiple of 128 for MXU efficiency (callers pad).
+    """
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    use_pallas = _on_tpu() if force_pallas is None else force_pallas
+    if not use_pallas:
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    tk = k.shape[2]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    out = _flash_attention_bhsd(qf, kf, vf, scale, causal, block_q, block_k)
+    return out.reshape(b, h, t, d)
+
+
+def repeat_kv(k: jax.Array, num_rep: int) -> jax.Array:
+    """Expand KV heads for grouped-query attention: [b, kvh, t, d] →
+    [b, kvh*num_rep, t, d]."""
+    if num_rep == 1:
+        return k
+    b, kvh, t, d = k.shape
+    return jnp.repeat(k, num_rep, axis=1)

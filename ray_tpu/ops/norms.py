@@ -1,0 +1,57 @@
+"""Normalization and positional-embedding ops.
+
+Pure-JAX implementations: XLA fuses these elementwise chains into the
+surrounding matmuls on TPU, so a hand-written kernel buys nothing
+(unlike attention, where the O(T^2) intermediate forces the fused
+Pallas kernel in attention.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in f32 accumulation, cast back to the input dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rotary_embedding(
+    positions: jax.Array, head_dim: int, theta: float = 10000.0
+):
+    """Rotary position embedding tables: returns (cos, sin) of shape
+    [*positions.shape, head_dim // 2], f32."""
+    half = head_dim // 2
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(
+    x: jax.Array, cos: jax.Array, sin: jax.Array
+) -> jax.Array:
+    """Apply RoPE to [batch, heads, seq, head_dim] given per-position
+    (cos, sin) of shape [batch, seq, head_dim//2] (or broadcastable)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    # cos/sin: [b, t, half] -> [b, 1, t, half] to broadcast over heads.
+    if cos.ndim == 3:
+        cos = cos[:, None, :, :]
+        sin = sin[:, None, :, :]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(dtype)
+
+
+def swiglu(x: jax.Array, gate: jax.Array) -> jax.Array:
+    """SwiGLU activation: silu(gate) * x."""
+    return jax.nn.silu(gate) * x

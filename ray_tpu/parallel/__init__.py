@@ -1,0 +1,42 @@
+"""Parallelism layer: named meshes, logical sharding rules, and an
+explicit collective API that compiles to XLA/ICI collectives."""
+
+from . import collective
+from .mesh import (
+    AXES,
+    MeshSpec,
+    batch_size_per_host,
+    data_axes,
+    model_axes,
+    single_host_mesh,
+)
+from .sharding import (
+    ACT_RULES,
+    PARAM_RULES,
+    Annotated,
+    annotate,
+    named_sharding,
+    shard_tree,
+    spec_for,
+    tree_shardings,
+    with_constraint,
+)
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "single_host_mesh",
+    "batch_size_per_host",
+    "data_axes",
+    "model_axes",
+    "collective",
+    "ACT_RULES",
+    "PARAM_RULES",
+    "Annotated",
+    "annotate",
+    "named_sharding",
+    "shard_tree",
+    "spec_for",
+    "tree_shardings",
+    "with_constraint",
+]

@@ -1,0 +1,104 @@
+"""Device-mesh construction for TPU pods.
+
+The TPU-native replacement for the reference's process-group world
+(reference: train/torch/config.py:115 builds a NCCL process group; here
+parallelism is expressed as a named `jax.sharding.Mesh` over which XLA
+compiles ICI/DCN collectives — SURVEY.md §5.8).
+
+Canonical axis names (outer→inner, matching ICI locality — inner axes
+get the fastest links):
+
+    pp  — pipeline-parallel stage
+    dp  — pure data parallel (replicated params)
+    fsdp— data parallel with sharded params/optimizer (ZeRO-3 analog)
+    sp  — sequence/context parallel (ring attention riders)
+    tp  — tensor parallel (megatron-style, innermost, highest traffic)
+    ep  — expert parallel for MoE (aliases onto sp/tp block as needed)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("pp", "dp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout; `build()` realizes it on devices."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1  # folded into (sp, tp) when building; see build()
+
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.pp
+
+    @staticmethod
+    def auto(
+        n_devices: Optional[int] = None,
+        *,
+        tp: int = 1,
+        sp: int = 1,
+        pp: int = 1,
+    ) -> "MeshSpec":
+        """Fill the fsdp axis with whatever devices remain."""
+        n = n_devices if n_devices is not None else len(jax.devices())
+        denom = tp * sp * pp
+        if n % denom != 0:
+            raise ValueError(
+                f"{n} devices not divisible by tp*sp*pp={denom}"
+            )
+        return MeshSpec(fsdp=n // denom, tp=tp, sp=sp, pp=pp)
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        need = self.num_devices()
+        if len(devices) < need:
+            raise ValueError(
+                f"MeshSpec needs {need} devices, have {len(devices)}"
+            )
+        shape = (self.pp, self.dp, self.fsdp, self.sp, self.tp)
+        grid = np.array(devices[:need]).reshape(shape)
+        return Mesh(grid, AXES)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            "pp": self.pp,
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "sp": self.sp,
+            "tp": self.tp,
+        }
+
+
+def single_host_mesh(**axis_sizes) -> Mesh:
+    return MeshSpec(**axis_sizes).build()
+
+
+def data_axes() -> Tuple[str, ...]:
+    """Mesh axes a batch dimension is sharded over."""
+    return ("dp", "fsdp")
+
+
+def model_axes() -> Tuple[str, ...]:
+    return ("tp",)
+
+
+def batch_size_per_host(global_batch: int, mesh: Mesh) -> int:
+    n_data = math.prod(mesh.shape[a] for a in data_axes())
+    if global_batch % n_data != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data-parallel "
+            f"size {n_data}"
+        )
+    return global_batch // n_data

@@ -1,0 +1,43 @@
+"""@remote functions (reference: python/ray/remote_function.py —
+RemoteFunction._remote:303 submits through the core worker; .options()
+re-binds per-call overrides)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+
+class RemoteFunction:
+    def __init__(self, func, options: Optional[Dict[str, Any]] = None):
+        self._function = func
+        self._options = dict(options or {})
+        self._exported_key: Optional[str] = None
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly; use "
+            f"{self._function.__name__}.remote()."
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(overrides)
+        clone = RemoteFunction(self._function, merged)
+        clone._exported_key = self._exported_key
+        return clone
+
+    def remote(self, *args, **kwargs):
+        from ._private.api_internal import submit_function
+
+        return submit_function(self, args, kwargs)
+
+    # internal
+    @property
+    def underlying(self):
+        return self._function
+
+    @property
+    def task_options(self) -> Dict[str, Any]:
+        return self._options

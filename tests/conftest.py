@@ -1,0 +1,51 @@
+"""Shared fixtures.
+
+JAX tests run hermetically on a virtual 8-device CPU mesh (the
+reference's analogous trick is the multi-raylet-in-one-box Cluster
+fixture + fake accelerator managers, SURVEY.md §4): sharding/pjit
+code paths compile and run without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+# Force CPU (the machine's env may point JAX at a TPU plugin): tests
+# must run hermetically on a virtual 8-device CPU mesh.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# A site-installed TPU plugin may force platform selection via
+# jax.config at interpreter start; override it back to CPU here, before
+# any test imports jax.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest
+
+
+@pytest.fixture
+def rt_session():
+    """A fresh single-node session per test (reference fixture:
+    ray_start_regular, python/ray/tests/conftest.py:463)."""
+    import ray_tpu as rt
+
+    session = rt.init(num_cpus=4, ignore_reinit_error=False)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture(scope="module")
+def rt_shared():
+    """Module-scoped session for cheap read-only tests (reference:
+    ray_start_regular_shared)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    rt.shutdown()

@@ -1,0 +1,302 @@
+"""Core task/actor/object API tests (modeled on the reference's
+python/ray/tests/test_basic*.py / test_actor*.py coverage)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    rt.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    rt.shutdown()
+
+
+def test_simple_task():
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_task_kwargs_and_closure():
+    base = 100
+
+    @rt.remote
+    def f(a, b=10):
+        return a + b + base
+
+    assert rt.get(f.remote(1)) == 111
+    assert rt.get(f.remote(1, b=20)) == 121
+
+
+def test_many_tasks():
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert rt.get(refs) == [i * i for i in range(50)]
+
+
+def test_put_get_roundtrip_small():
+    ref = rt.put({"a": [1, 2, 3], "b": "hello"})
+    assert rt.get(ref) == {"a": [1, 2, 3], "b": "hello"}
+
+
+def test_put_get_large_numpy_zero_copy():
+    arr = np.arange(500_000, dtype=np.float32).reshape(500, 1000)
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(out, arr)
+    # Large objects come back as views over shared memory (zero-copy).
+    assert not out.flags.writeable
+
+
+def test_object_ref_as_arg():
+    @rt.remote
+    def total(x):
+        return float(x.sum())
+
+    arr = np.ones(300_000, dtype=np.float64)
+    ref = rt.put(arr)
+    assert rt.get(total.remote(ref)) == 300_000.0
+
+
+def test_chained_tasks():
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 6
+
+
+def test_num_returns():
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates_type():
+    @rt.remote
+    def boom():
+        raise ValueError("broken")
+
+    with pytest.raises(ValueError, match="broken"):
+        rt.get(boom.remote())
+
+
+def test_error_propagates_through_dependency():
+    @rt.remote
+    def boom():
+        raise KeyError("first")
+
+    @rt.remote
+    def use(x):
+        return x
+
+    with pytest.raises(KeyError):
+        rt.get(use.remote(boom.remote()))
+
+
+def test_get_timeout():
+    @rt.remote
+    def slow():
+        import time
+
+        time.sleep(30)
+
+    with pytest.raises(exc.GetTimeoutError):
+        rt.get(slow.remote(), timeout=0.2)
+
+
+def test_wait():
+    @rt.remote
+    def fast(i):
+        return i
+
+    @rt.remote
+    def slow():
+        import time
+
+        time.sleep(30)
+
+    refs = [fast.remote(i) for i in range(3)] + [slow.remote()]
+    ready, remaining = rt.wait(refs, num_returns=3, timeout=10)
+    assert len(ready) == 3
+    assert len(remaining) == 1
+
+
+def test_nested_tasks():
+    @rt.remote
+    def inner(x):
+        return x * 2
+
+    @rt.remote
+    def outer(x):
+        return rt.get(inner.remote(x)) + 1
+
+    assert rt.get(outer.remote(10)) == 21
+
+
+def test_actor_basics():
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, by=1):
+            self.v += by
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(5)
+    assert rt.get(c.inc.remote()) == 6
+    assert rt.get(c.inc.remote(by=4)) == 10
+    assert rt.get(c.value.remote()) == 10
+
+
+def test_actor_ordering():
+    @rt.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.append.remote(i)
+    assert rt.get(a.get.remote()) == list(range(20))
+
+
+def test_named_actor():
+    @rt.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg").remote()
+    handle = rt.get_actor("reg")
+    assert rt.get(handle.ping.remote()) == "pong"
+
+
+def test_actor_handle_passing():
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @rt.remote
+    def writer(store):
+        rt.get(store.set.remote(42))
+        return True
+
+    s = Store.remote()
+    rt.get(writer.remote(s))
+    assert rt.get(s.get.remote()) == 42
+
+
+def test_actor_error():
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        rt.get(b.fail.remote())
+
+
+def test_kill_actor():
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "alive"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote()) == "alive"
+    rt.kill(v)
+    with pytest.raises(
+        (exc.ActorDiedError, exc.ActorUnavailableError, exc.WorkerCrashedError)
+    ):
+        rt.get(v.ping.remote(), timeout=10)
+
+
+def test_cancel_queued_task():
+    @rt.remote
+    def blocker():
+        import time
+
+        time.sleep(60)
+
+    @rt.remote
+    def victim():
+        return 1
+
+    # Saturate the 4 CPUs, then queue + cancel the victim.
+    blockers = [blocker.remote() for _ in range(4)]
+    ref = victim.remote()
+    import time
+
+    time.sleep(0.5)
+    rt.cancel(ref)
+    with pytest.raises((exc.TaskCancelledError, exc.RayTpuError)):
+        rt.get(ref, timeout=5)
+    del blockers
+
+
+def test_cluster_resources():
+    total = rt.cluster_resources()
+    assert total["CPU"] == 4.0
+
+
+def test_fractional_resources():
+    @rt.remote(num_cpus=0.5)
+    def half():
+        return 1
+
+    assert rt.get([half.remote() for _ in range(8)]) == [1] * 8
+
+
+def test_task_events_recorded():
+    @rt.remote
+    def traced():
+        return 1
+
+    rt.get(traced.remote())
+    # task_done (which records FINISHED) is a fire-and-forget
+    # notification that can land just after get() returns.
+    import time
+
+    states = []
+    for _ in range(50):
+        events = rt.timeline()
+        states = [e["state"] for e in events if e["name"] == "traced"]
+        if "FINISHED" in states:
+            break
+        time.sleep(0.1)
+    assert "RUNNING" in states
+    assert "FINISHED" in states

@@ -1,0 +1,148 @@
+"""Unit tests for the shared-memory store, serialization, IDs, and the
+resource arithmetic (reference analogs: plasma store tests, FixedPoint
+tests in src/ray/common/scheduling)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (
+    ObjectStoreFullError,
+    SharedMemoryStore,
+)
+from ray_tpu._private.scheduler import ResourceSet
+from ray_tpu._private.serialization import SerializationContext
+
+
+def _oid(i=1):
+    return ObjectID.for_return(TaskID.from_random(), i)
+
+
+class TestIDs:
+    def test_object_id_embeds_task(self):
+        t = TaskID.from_random()
+        o = ObjectID.for_return(t, 3)
+        assert o.task_id() == t
+        assert o.index() == 3
+
+    def test_task_id_deterministic(self):
+        job = JobID.from_int(1)
+        parent = TaskID.for_driver(job)
+        a = TaskID.for_task(job, parent, 7)
+        b = TaskID.for_task(job, parent, 7)
+        c = TaskID.for_task(job, parent, 8)
+        assert a == b
+        assert a != c
+
+    def test_hex_roundtrip(self):
+        a = ActorID.of(JobID.from_int(9))
+        assert ActorID.from_hex(a.hex()) == a
+        assert a.job_id() == JobID.from_int(9)
+
+
+class TestSerialization:
+    def test_roundtrip_plain(self):
+        ctx = SerializationContext()
+        data = ctx.serialize({"x": 1, "y": [1, 2]}).to_bytes()
+        assert ctx.deserialize(data) == {"x": 1, "y": [1, 2]}
+
+    def test_numpy_out_of_band_zero_copy(self):
+        ctx = SerializationContext()
+        arr = np.arange(10_000, dtype=np.float64)
+        serialized = ctx.serialize(arr)
+        # Large arrays must go out-of-band, not through the pickle
+        # stream (zero-copy requirement).
+        assert len(serialized.buffers) == 1
+        assert serialized.buffers[0].nbytes == arr.nbytes
+        out = ctx.deserialize(serialized.to_bytes())
+        np.testing.assert_array_equal(out, arr)
+
+    def test_nested_arrays(self):
+        ctx = SerializationContext()
+        value = {"a": np.ones(5000), "b": [np.zeros(3000), "meta"]}
+        out = ctx.deserialize(ctx.serialize(value).to_bytes())
+        np.testing.assert_array_equal(out["a"], value["a"])
+        np.testing.assert_array_equal(out["b"][0], value["b"][0])
+
+
+class TestSharedMemoryStore:
+    def test_create_seal_get(self):
+        store = SharedMemoryStore("deadbeef", 1 << 20)
+        oid = _oid()
+        buf = store.create(oid, 5)
+        buf[:5] = b"hello"
+        assert not store.contains(oid)
+        store.seal(oid)
+        assert store.contains(oid)
+        assert bytes(store.get(oid)[:5]) == b"hello"
+        store.shutdown()
+
+    def test_get_blocks_until_seal(self):
+        import threading
+
+        store = SharedMemoryStore("deadbee2", 1 << 20)
+        oid = _oid()
+
+        def writer():
+            import time
+
+            time.sleep(0.1)
+            buf = store.create(oid, 3)
+            buf[:3] = b"abc"
+            store.seal(oid)
+
+        threading.Thread(target=writer).start()
+        view = store.get(oid, timeout=5)
+        assert bytes(view[:3]) == b"abc"
+        store.shutdown()
+
+    def test_capacity_and_eviction(self):
+        store = SharedMemoryStore("deadbee3", 4096 * 4)
+        oids = [_oid(i + 1) for i in range(4)]
+        for oid in oids:
+            store.put(oid, b"x" * 4096)
+        # Store is full; the next create evicts the LRU object.
+        store.put(_oid(99), b"y" * 4096)
+        assert not store.contains(oids[0])
+        store.shutdown()
+
+    def test_pinned_objects_not_evicted(self):
+        store = SharedMemoryStore("deadbee4", 4096 * 2)
+        first = _oid(1)
+        store.put(first, b"x" * 4096)
+        store.pin(first)
+        with pytest.raises(ObjectStoreFullError):
+            store.put(_oid(2), b"y" * 8192)
+        assert store.contains(first)
+        store.shutdown()
+
+    def test_cross_instance_open(self):
+        # Two store instances with the same node prefix model two
+        # processes mapping the same segments.
+        producer = SharedMemoryStore("deadbee5", 1 << 20)
+        consumer = SharedMemoryStore("deadbee5", 1 << 20)
+        oid = _oid()
+        producer.put(oid, b"shared-bytes")
+        view = consumer.open_remote(oid, 12)
+        assert bytes(view[:12]) == b"shared-bytes"
+        consumer.shutdown(unlink=False)
+        producer.shutdown()
+
+
+class TestResourceSet:
+    def test_fits_and_subtract(self):
+        total = ResourceSet({"CPU": 4, "TPU": 8})
+        req = ResourceSet({"CPU": 0.5, "TPU": 1})
+        assert req.fits_in(total)
+        left = total.subtract(req)
+        assert left.get("CPU") == 3.5
+        assert left.get("TPU") == 7
+
+    def test_fractional_exact(self):
+        total = ResourceSet({"CPU": 1})
+        third = ResourceSet({"CPU": 0.333})
+        left = total.subtract(third).subtract(third).subtract(third)
+        assert left.get("CPU") == pytest.approx(0.001)
+
+    def test_missing_resource_does_not_fit(self):
+        assert not ResourceSet({"TPU": 1}).fits_in(ResourceSet({"CPU": 4}))
