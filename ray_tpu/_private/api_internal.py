@@ -42,14 +42,24 @@ def _task_resources(options: Dict[str, Any], default_cpu: float) -> dict:
     return {k: v for k, v in resources.items() if v}
 
 
+def _export_cached(obj, cache_holder, attr: str, worker) -> str:
+    """Export once per session: the cache is invalidated when the
+    worker changes (shutdown()+init() starts a fresh KV)."""
+    cached = getattr(cache_holder, attr)
+    if cached is not None and cached[0] is worker:
+        return cached[1]
+    key = worker.functions.export(obj)
+    setattr(cache_holder, attr, (worker, key))
+    return key
+
+
 def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
     worker = _require_worker()
     opts = rf.task_options
-    if rf._exported_key is None:
-        rf._exported_key = worker.functions.export(rf.underlying)
+    func_key = _export_cached(rf.underlying, rf, "_exported_key", worker)
     num_returns = opts.get("num_returns", 1)
     refs = worker.submit_task(
-        rf._exported_key,
+        func_key,
         _flatten_args(args, kwargs),
         name=rf.underlying.__name__,
         num_returns=num_returns,
@@ -62,15 +72,14 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
 def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
     worker = _require_worker()
     opts = ac.actor_options
-    if ac._exported_key is None:
-        ac._exported_key = worker.functions.export(ac.underlying)
+    class_key = _export_cached(ac.underlying, ac, "_exported_key", worker)
     meta = {
         "class_name": ac.underlying.__name__,
         "methods": ac.method_names(),
-        "class_key": ac._exported_key,
+        "class_key": class_key,
     }
     actor_id = worker.create_actor(
-        ac._exported_key,
+        class_key,
         _flatten_args(args, kwargs),
         class_name=ac.underlying.__name__,
         name=opts.get("name"),

@@ -393,6 +393,28 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # tasks
     # ------------------------------------------------------------------
+    def _pin_args(self, spec: dict) -> None:
+        """Hold a reference on every ObjectRef argument for the task's
+        lifetime so caller-side handle drops can't delete an object a
+        queued task still needs (reference: ReferenceCounter pins
+        submitted-task arguments, reference_count.h)."""
+        with self._lock:
+            for kind, payload in spec["args"]:
+                if kind == "ref":
+                    self._ensure_entry(ObjectID(payload)).refcount += 1
+
+    def _unpin_args(self, spec: dict) -> None:
+        self._h_del_ref(
+            None,
+            {
+                "oids": [
+                    payload
+                    for kind, payload in spec["args"]
+                    if kind == "ref"
+                ]
+            },
+        )
+
     def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
         task_id = TaskID(spec["task_id"])
@@ -402,6 +424,7 @@ class NodeDaemon:
             )
             for ret in spec["returns"]:
                 self._ensure_entry(ObjectID(ret))
+        self._pin_args(spec)
         self._record_task_event(spec, "PENDING_ARGS_AVAIL")
         self.scheduler.enqueue(
             task_id, ResourceSet(spec.get("resources", {})), spec
@@ -429,6 +452,7 @@ class NodeDaemon:
             self.tasks[task_id] = TaskEntry(spec=spec)
             for ret in spec["returns"]:
                 self._ensure_entry(ObjectID(ret))
+        self._pin_args(spec)
         self.scheduler.enqueue(
             task_id, ResourceSet(spec.get("resources", {})), spec
         )
@@ -446,6 +470,7 @@ class NodeDaemon:
             )
             for ret in spec["returns"]:
                 self._ensure_entry(ObjectID(ret))
+        self._pin_args(spec)
         if runtime is None or runtime.info.state == ACTOR_DEAD:
             self._fail_task_returns(
                 spec, "ActorDiedError", "actor is dead"
@@ -492,13 +517,18 @@ class NodeDaemon:
                 self._record_task_event(spec, "FINISHED")
             if spec["kind"] == "actor_creation":
                 self._on_actor_created(spec, error, conn.conn_id)
-            if spec["kind"] == "actor_task":
+                if error is not None:
+                    self.scheduler.release(task_id)
+                # else: a live actor holds its creation resources until
+                # death (_on_actor_worker_death / _mark_actor_dead).
+            elif spec["kind"] == "actor_task":
                 with self._lock:
                     runtime = self.actors.get(ActorID(spec["actor_id"]))
                     if runtime is not None:
                         runtime.inflight.pop(task_id, None)
             else:
                 self.scheduler.release(task_id)
+            self._unpin_args(spec)
             with self._lock:
                 entry.state = "DONE"
         # Return the worker to the pool (actor workers stay pinned).
@@ -516,6 +546,7 @@ class NodeDaemon:
         for ret in spec["returns"]:
             self._seal_error(ObjectID(ret), payload)
         self._record_task_event(spec, "FAILED")
+        self._unpin_args(spec)
 
     def _h_cancel_task(self, conn, msg):
         task_id = TaskID(msg["task_id"])
@@ -756,15 +787,18 @@ class NodeDaemon:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
         )
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env,
-            stdout=open(
-                os.path.join(self.session_dir, f"worker-{len(self._worker_procs)}.out"),
-                "ab",
-            ),
-            stderr=subprocess.STDOUT,
+        log_path = os.path.join(
+            self.session_dir, f"worker-{len(self._worker_procs)}.out"
         )
+        with open(log_path, "ab") as log_file:
+            # The child holds its own copy of the fd; closing ours
+            # immediately avoids leaking one fd per spawn.
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+            )
         self._worker_procs.append(proc)
         self._watch_worker_start(proc)
 

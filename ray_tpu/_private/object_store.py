@@ -5,8 +5,9 @@ memory store for small objects and the plasma shared-memory store for
 large ones (reference: src/ray/core_worker/store_provider/,
 src/ray/object_manager/plasma/store.h):
 
-* `InProcessStore` — small objects (≤ max_direct_call_object_size) live
-  in the owner process and are inlined into task specs/replies.
+* Small objects (≤ max_direct_call_object_size) are inlined into task
+  specs/replies and live in the node daemon's object table (the
+  in-process memory-store tier of the reference).
 
 * `SharedMemoryStore` — immutable shared-memory objects, one POSIX SHM
   segment per object, readable zero-copy by every process on the node.
@@ -261,40 +262,3 @@ class SharedMemoryStore:
         with self._lock:
             for oid in list(self._entries):
                 self.delete(oid, unlink=unlink)
-
-
-class InProcessStore:
-    """Owner-process store for small objects (reference:
-    core_worker/store_provider/memory_store/)."""
-
-    def __init__(self):
-        self._objects: Dict[ObjectID, bytes] = {}
-        self._lock = threading.Lock()
-        self._events: Dict[ObjectID, threading.Event] = {}
-
-    def put(self, object_id: ObjectID, data: bytes) -> None:
-        with self._lock:
-            self._objects[object_id] = bytes(data)
-            event = self._events.pop(object_id, None)
-        if event is not None:
-            event.set()
-
-    def contains(self, object_id: ObjectID) -> bool:
-        with self._lock:
-            return object_id in self._objects
-
-    def get(
-        self, object_id: ObjectID, timeout: Optional[float] = None
-    ) -> Optional[bytes]:
-        with self._lock:
-            if object_id in self._objects:
-                return self._objects[object_id]
-            event = self._events.setdefault(object_id, threading.Event())
-        if not event.wait(timeout=timeout):
-            return None
-        with self._lock:
-            return self._objects.get(object_id)
-
-    def delete(self, object_id: ObjectID) -> None:
-        with self._lock:
-            self._objects.pop(object_id, None)

@@ -268,6 +268,10 @@ class RpcClient:
         self._sock = self._connect(connect_timeout)
         self._mid = 0
         self._lock = threading.Lock()
+        # Serializes whole frames: call()/notify() run on arbitrary
+        # threads (ObjectRef.__del__ fires on GC threads) and an
+        # interleaved sendall would corrupt the length-prefixed wire.
+        self._send_lock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
         self._replies: Dict[int, dict] = {}
         self._closed = False
@@ -359,7 +363,8 @@ class RpcClient:
         msg["_method"] = method
         msg["_mid"] = mid
         try:
-            send_msg(self._sock, msg)
+            with self._send_lock:
+                send_msg(self._sock, msg)
         except ConnectionLost:
             with self._lock:
                 self._pending.pop(mid, None)
@@ -377,7 +382,8 @@ class RpcClient:
         msg["_method"] = method
         msg["_mid"] = 0
         try:
-            send_msg(self._sock, msg)
+            with self._send_lock:
+                send_msg(self._sock, msg)
         except ConnectionLost:
             pass
 

@@ -162,12 +162,3 @@ class LocalScheduler:
             request = self._running.pop(task_id, None)
             if request is not None:
                 self._available = self._available.add(request)
-
-    def acquire_direct(self, task_id, request: ResourceSet) -> bool:
-        """Acquire resources outside the queue (e.g. restarted actors)."""
-        with self._lock:
-            if not request.fits_in(self._available):
-                return False
-            self._available = self._available.subtract(request)
-            self._running[task_id] = request
-            return True

@@ -28,6 +28,8 @@ import struct
 from dataclasses import dataclass, field
 from typing import Any
 
+import cloudpickle
+
 _ALIGN = 64  # TPU-friendly alignment for zero-copy into XLA.
 
 
@@ -89,7 +91,10 @@ class SerializationContext:
 
     def serialize(self, value: Any) -> SerializedObject:
         buffers: list[pickle.PickleBuffer] = []
-        inband = pickle.dumps(
+        # cloudpickle so lambdas/closures/local functions work as task
+        # args and return values (reference vendors cloudpickle for the
+        # same reason, python/ray/cloudpickle/).
+        inband = cloudpickle.dumps(
             value, protocol=5, buffer_callback=buffers.append
         )
         return SerializedObject(

@@ -1,0 +1,19 @@
+"""Model families. Flagship: Llama (BASELINE.md north star)."""
+
+from .llama import (
+    LlamaConfig,
+    flops_per_token,
+    forward,
+    init_params,
+    loss_fn,
+    param_annotations,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "forward",
+    "loss_fn",
+    "init_params",
+    "param_annotations",
+    "flops_per_token",
+]

@@ -35,6 +35,21 @@ def _interpret() -> bool:
     return jax.default_backend() in ("cpu",)
 
 
+def _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len):
+    """Mask out-of-range KV columns (sequence padded to block
+    multiples) and, when causal, future positions."""
+    rows = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    ) + qi * block_q
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    ) + ki * block_k
+    valid = cols < kv_len
+    if causal:
+        valid = jnp.logical_and(valid, rows >= cols)
+    return jnp.where(valid, s, DEFAULT_MASK_VALUE)
+
+
 def mha_reference(
     q: jax.Array,
     k: jax.Array,
@@ -68,6 +83,7 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, out_ref, lse_ref,
     acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -93,14 +109,7 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        if causal:
-            rows = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            ) + qi * block_q
-            cols = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            ) + ki * block_k
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
 
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -126,7 +135,7 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(row[None, :], lse_ref.shape[1:])
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k):
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len):
     bh, t, d = q.shape
     tk = k.shape[1]
     nq = pl.cdiv(t, block_q)
@@ -138,6 +147,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        kv_len=kv_len,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -173,6 +183,7 @@ def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     acc_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -198,14 +209,7 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            ) + qi * block_q
-            cols = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            ) + ki * block_k
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -226,6 +230,7 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int, q_len: int,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -252,15 +257,13 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            ) + qi * block_q
-            cols = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            ) + ki * block_k
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse)  # [bq, bk]
+        # Padded q rows (beyond q_len) must not contribute to dk/dv.
+        row_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        ) + qi * block_q
+        p = jnp.where(row_ids < q_len, p, 0.0)
         dv_acc_ref[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -281,7 +284,7 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k, kv_len, q_len):
     bh, t, d = q.shape
     tk = k.shape[1]
     nq = pl.cdiv(t, block_q)
@@ -296,6 +299,7 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             _bwd_dq_kernel,
             scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
+            kv_len=kv_len,
         ),
         grid=(bh, nq, nk),
         in_specs=[
@@ -317,6 +321,7 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             _bwd_dkv_kernel,
             scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
+            kv_len=kv_len, q_len=q_len,
         ),
         grid=(bh, nk, nq),
         in_specs=[
@@ -356,22 +361,29 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
-def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+def _flash_attention_bhsd(
+    q, k, v, scale, causal, block_q, block_k, kv_len, q_len
+):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, kv_len, q_len):
+    out, lse = _flash_forward(
+        q, k, v, scale, causal, block_q, block_k, kv_len
+    )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, residuals, do):
+def _flash_bwd_rule(
+    scale, causal, block_q, block_k, kv_len, q_len, residuals, do
+):
     q, k, v, out, lse = residuals
     dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, do, scale, causal, block_q, block_k
+        q, k, v, out, lse, do, scale, causal, block_q, block_k,
+        kv_len, q_len,
     )
     return dq, dk, dv
 
@@ -406,8 +418,20 @@ def flash_attention(
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
-    out = _flash_attention_bhsd(qf, kf, vf, scale, causal, block_q, block_k)
-    return out.reshape(b, h, t, d)
+    # Pad sequences to block multiples with defined zeros; kernels mask
+    # columns >= tk (and padded rows in the dk/dv pass), and the q
+    # padding is sliced off the output.
+    t_pad = -t % block_q
+    tk_pad = -tk % block_k
+    if t_pad:
+        qf = jnp.pad(qf, ((0, 0), (0, t_pad), (0, 0)))
+    if tk_pad:
+        kf = jnp.pad(kf, ((0, 0), (0, tk_pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, tk_pad), (0, 0)))
+    out = _flash_attention_bhsd(
+        qf, kf, vf, scale, causal, block_q, block_k, tk, t
+    )
+    return out[:, :t, :].reshape(b, h, t, d)
 
 
 def repeat_kv(k: jax.Array, num_rep: int) -> jax.Array:

@@ -66,11 +66,13 @@ def reducescatter(x, axis: Axis, *, scatter_axis: int = 0, op: str = "sum"):
 def broadcast(x, axis: Axis, root: int = 0):
     """Every member receives root's value (reference: collective.py:300).
 
-    Implemented as a masked psum — XLA lowers this to an ICI broadcast.
+    Non-root values are discarded with `where` (not multiplied by 0,
+    which would propagate their NaN/Inf) before a psum that XLA lowers
+    to an ICI broadcast.
     """
     idx = lax.axis_index(axis)
-    mask = (idx == root).astype(x.dtype)
-    return lax.psum(x * mask, axis)
+    selected = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(selected, axis)
 
 
 def send_recv(x, axis: Axis, *, shift: int = 1):

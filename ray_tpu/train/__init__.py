@@ -1,0 +1,50 @@
+"""Training library: JaxTrainer (DataParallelTrainer-shaped), sharded
+train steps, sessions, backends, and checkpointing."""
+
+from .backend import Backend, CpuTestBackend, JaxBackend
+from .checkpoint import (
+    CheckpointManager,
+    load_metadata,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from .config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from .session import get_checkpoint, get_context, report
+from .train_step import (
+    TrainState,
+    default_optimizer,
+    make_train_step,
+    shard_batch,
+)
+from .trainer import JaxTrainer
+from .worker_group import WorkerGroup
+
+__all__ = [
+    "JaxTrainer",
+    "ScalingConfig",
+    "RunConfig",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "Backend",
+    "JaxBackend",
+    "CpuTestBackend",
+    "WorkerGroup",
+    "TrainState",
+    "make_train_step",
+    "default_optimizer",
+    "shard_batch",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "load_metadata",
+    "CheckpointManager",
+]

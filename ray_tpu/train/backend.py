@@ -1,0 +1,67 @@
+"""Training backends: per-gang accelerator setup hooks.
+
+Reference anatomy (train/backend.py Backend.on_start/on_training_start/
+on_shutdown; torch/config.py:66 _setup_torch_process_group;
+torch/xla/config.py:120 proves accelerator-specific pod init belongs in
+a Backend). The TPU/JAX backend's job is the multi-host rendezvous the
+reference does with NCCL init: run `jax.distributed.initialize` on
+every gang worker with the coordinator address, then build the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config: dict) -> None:
+        """Called after the worker gang is up, before the train loop."""
+
+    def on_shutdown(self, worker_group) -> None:
+        """Called when training finishes."""
+
+
+class JaxBackend(Backend):
+    """Initializes JAX multi-host coordination across the gang
+    (replaces torch dist.init_process_group(backend='nccl'),
+    reference train/torch/config.py:115).
+    """
+
+    def on_start(self, worker_group, backend_config: dict) -> None:
+        coordinator = backend_config.get("coordinator_address")
+        num_processes = worker_group.size
+        if coordinator is None or num_processes <= 1:
+            return
+
+        def _init_jax_distributed(coordinator, num_processes, process_id):
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+
+        worker_group.run_per_rank(
+            _init_jax_distributed,
+            lambda rank: (coordinator, num_processes, rank),
+        )
+
+
+class CpuTestBackend(Backend):
+    """Forces workers onto the CPU backend with N virtual devices —
+    the hermetic-test analog of a TPU slice (SURVEY.md §4 lesson)."""
+
+    def on_start(self, worker_group, backend_config: dict) -> None:
+        n = backend_config.get("virtual_devices", 8)
+
+        def _force_cpu(n):
+            import os
+
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
+        worker_group.run_per_rank(_force_cpu, lambda rank: (n,))

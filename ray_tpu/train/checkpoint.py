@@ -1,0 +1,75 @@
+"""Checkpoint save/restore with top-k retention.
+
+Orbax-backed sharded checkpointing (the TPU ecosystem standard),
+wrapped in the reference's Checkpoint-directory semantics (reference:
+train/_checkpoint.py Checkpoint = a directory handle;
+train/_internal/checkpoint_manager.py top-k retention by score)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def save_checkpoint(path: str, state: Any, metadata: Optional[dict] = None):
+    """Save a pytree (sharded arrays gathered per-host by orbax)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), state)
+    ckptr.wait_until_finished()
+    if metadata is not None:
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+
+def restore_checkpoint(path: str, target: Any) -> Any:
+    """Restore into the sharding/structure of `target` (an abstract or
+    concrete pytree)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.join(path, "state"), target)
+
+
+def load_metadata(path: str) -> dict:
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+class CheckpointManager:
+    """Keep-last-k checkpoint retention (reference:
+    train/_internal/checkpoint_manager.py; score-based top-k TBD)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None):
+        self.root = os.path.abspath(root)
+        self.num_to_keep = num_to_keep
+        os.makedirs(self.root, exist_ok=True)
+        self._checkpoints: List[Tuple[int, str]] = []
+
+    def save(self, step: int, state: Any, metrics: Optional[dict] = None):
+        path = os.path.join(self.root, f"checkpoint_{step:08d}")
+        save_checkpoint(path, state, {"step": step, **(metrics or {})})
+        self._checkpoints.append((step, path))
+        if self.num_to_keep is not None:
+            while len(self._checkpoints) > self.num_to_keep:
+                _, old = self._checkpoints.pop(0)
+                shutil.rmtree(old, ignore_errors=True)
+        return path
+
+    def latest(self) -> Optional[str]:
+        existing = sorted(
+            d
+            for d in os.listdir(self.root)
+            if d.startswith("checkpoint_")
+        )
+        if not existing:
+            return None
+        return os.path.join(self.root, existing[-1])

@@ -1,0 +1,82 @@
+"""Per-worker training session: report(), get_context(), checkpoints.
+
+Mirrors the reference's _TrainSession surface (reference:
+train/_internal/session.py:111 — a per-worker session object; user code
+calls train.report(metrics, checkpoint=...):667, get_context, and
+get_checkpoint:754 for restore)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: Optional[str] = None
+    mesh: Any = None  # realized jax Mesh for this gang
+
+
+class _Session:
+    def __init__(self, context: TrainContext, result_callback=None):
+        self.context = context
+        self.results: List[Dict[str, Any]] = []
+        self.latest_checkpoint: Optional[str] = None
+        self._result_callback = result_callback
+        self._lock = threading.Lock()
+
+    def report(
+        self, metrics: Dict[str, Any], checkpoint: Optional[str] = None
+    ) -> None:
+        with self._lock:
+            self.results.append(dict(metrics))
+            if checkpoint is not None:
+                self.latest_checkpoint = checkpoint
+        if self._result_callback is not None:
+            self._result_callback(metrics, checkpoint)
+
+
+_session_holder = threading.local()
+
+
+def init_session(context: TrainContext, result_callback=None) -> _Session:
+    session = _Session(context, result_callback)
+    _session_holder.session = session
+    return session
+
+
+def clear_session() -> None:
+    _session_holder.session = None
+
+
+def get_session() -> Optional[_Session]:
+    return getattr(_session_holder, "session", None)
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[str] = None) -> None:
+    """Report metrics (and optionally a checkpoint dir) from the train
+    loop (reference: train.report, session.py:667)."""
+    session = get_session()
+    if session is None:
+        raise RuntimeError(
+            "report() called outside a training session"
+        )
+    session.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    session = get_session()
+    if session is None:
+        return TrainContext()
+    return session.context
+
+
+def get_checkpoint() -> Optional[str]:
+    session = get_session()
+    return session.latest_checkpoint if session else None

@@ -1,0 +1,139 @@
+"""JaxTrainer — the DataParallelTrainer-shaped entry point.
+
+Reference call stack being mirrored (SURVEY.md §3.4): TorchTrainer.fit
+→ DataParallelTrainer.training_loop → BackendExecutor.start (creates
+the worker gang, sets ranks, runs backend hooks) → per-worker
+train_loop_per_worker with a session for report()/checkpoints →
+TrainingIterator gathers results; failures restart from the latest
+checkpoint up to FailureConfig.max_failures (backend_executor.py:759).
+
+TPU-native shape: `num_workers=1` is the single-controller JAX mode —
+the loop runs in-process and pjit spans every device the process sees
+(a whole slice on real pods). `num_workers>1` builds an actor gang via
+WorkerGroup + JaxBackend rendezvous for multi-host DCN setups.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from .backend import Backend, JaxBackend
+from .config import Result, RunConfig, ScalingConfig
+from .session import TrainContext, clear_session, init_session
+from .worker_group import WorkerGroup
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[Optional[dict]], Any],
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend: Optional[Backend] = None,
+        backend_config: Optional[dict] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend = backend or JaxBackend()
+        self.backend_config = backend_config or {}
+        self.datasets = datasets or {}
+
+    # -- public API (reference: BaseTrainer.fit, base_trainer.py:567) --
+    def fit(self) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        while True:
+            try:
+                return self._fit_once()
+            except Exception as e:  # noqa: BLE001
+                attempt += 1
+                if attempt > max_failures:
+                    return Result(
+                        metrics={}, checkpoint_path=None, error=e
+                    )
+                traceback.print_exc()
+
+    # ------------------------------------------------------------------
+    def _fit_once(self) -> Result:
+        name = self.run_config.name or "jax_trainer"
+        storage = self.run_config.storage_path or tempfile.mkdtemp(
+            prefix=f"rt_train_{name}_"
+        )
+        os.makedirs(storage, exist_ok=True)
+        if self.scaling_config.num_workers <= 1:
+            return self._fit_local(name, storage)
+        return self._fit_gang(name, storage)
+
+    def _loop_args(self):
+        return (
+            (self._train_loop_config,)
+            if self._train_loop_config is not None
+            or self._takes_config()
+            else ()
+        )
+
+    def _takes_config(self) -> bool:
+        import inspect
+
+        try:
+            sig = inspect.signature(self._train_loop)
+            return len(sig.parameters) > 0
+        except (TypeError, ValueError):
+            return False
+
+    def _fit_local(self, name: str, storage: str) -> Result:
+        """Single-controller path: the loop runs here, pjit spans all
+        visible devices."""
+        history = []
+
+        def on_result(metrics, checkpoint):
+            history.append(dict(metrics))
+
+        context = TrainContext(
+            world_rank=0,
+            world_size=1,
+            experiment_name=name,
+            trial_dir=storage,
+        )
+        session = init_session(context, result_callback=on_result)
+        try:
+            self._train_loop(*self._loop_args())
+        finally:
+            clear_session()
+        metrics = history[-1] if history else {}
+        return Result(
+            metrics=metrics,
+            checkpoint_path=session.latest_checkpoint,
+            metrics_history=history,
+        )
+
+    def _fit_gang(self, name: str, storage: str) -> Result:
+        """Multi-worker gang over the actor runtime (reference:
+        BackendExecutor.start + start_training)."""
+        group = WorkerGroup(
+            self.scaling_config.num_workers,
+            self.scaling_config.resources_per_worker,
+        )
+        try:
+            self.backend.on_start(group, self.backend_config)
+            outs = group.run_train_loop(
+                self._train_loop, name, self._loop_args()
+            )
+        finally:
+            self.backend.on_shutdown(group)
+            group.shutdown()
+        rank0 = outs[0]
+        history = rank0["reported"]
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint_path=rank0["checkpoint"],
+            metrics_history=history,
+        )

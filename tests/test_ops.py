@@ -201,3 +201,35 @@ class TestNorms:
             np.asarray(out), [0.0, 2.0 * 10.0 / (1 + np.exp(-10.0))],
             rtol=1e-5,
         )
+
+
+class TestFlashAttentionPadding:
+    """Sequence lengths not divisible by block sizes must be exact
+    (kernels mask padded KV columns and padded q rows)."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("t", [100, 300])
+    def test_ragged_lengths_forward_and_grad(self, causal, t):
+        q, k, v = _qkv(jax.random.PRNGKey(7), h=1, t=t, d=128)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=causal, block_q=128, block_k=128,
+                force_pallas=True,
+            )
+            return jnp.sum(out * out)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+        np.testing.assert_allclose(
+            float(loss_flash(q, k, v)), float(loss_ref(q, k, v)),
+            rtol=1e-4,
+        )
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3,
+                err_msg=f"d{name}",
+            )

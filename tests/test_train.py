@@ -1,0 +1,239 @@
+"""Training-stack tests: sharded train step (dp+fsdp+tp on the virtual
+mesh), JaxTrainer fit, sessions, checkpointing, worker gangs."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_annotations,
+)
+from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.train import (
+    CheckpointManager,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    default_optimizer,
+    make_train_step,
+    report,
+    restore_checkpoint,
+    save_checkpoint,
+    shard_batch,
+)
+
+
+def _tiny_cfg():
+    return LlamaConfig.tiny()
+
+
+def _mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return MeshSpec(dp=2, fsdp=2, tp=2).build()
+
+
+class TestTrainStep:
+    def test_loss_decreases_sharded(self):
+        mesh = _mesh()
+        cfg = _tiny_cfg()
+        opt = default_optimizer(learning_rate=1e-2, total_steps=50)
+        init_fn, step_fn = make_train_step(
+            lambda p, t, y: loss_fn(p, t, y, cfg),
+            opt,
+            mesh,
+            param_annotations(cfg),
+        )
+        state = init_fn(jax.random.PRNGKey(0), lambda k: init_params(k, cfg))
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+        )
+        toks = shard_batch(toks, mesh, logical_axes=("batch", None))
+        inp, tgt = toks[:, :-1], toks[:, 1:]
+        first = None
+        for _ in range(10):
+            state, metrics = step_fn(state, inp, tgt)
+            if first is None:
+                first = float(metrics["loss"])
+        last = float(metrics["loss"])
+        assert last < first, (first, last)
+        assert int(state.step) == 10
+
+    def test_params_are_sharded(self):
+        mesh = _mesh()
+        cfg = _tiny_cfg()
+        opt = default_optimizer(total_steps=10)
+        init_fn, _ = make_train_step(
+            lambda p, t, y: loss_fn(p, t, y, cfg),
+            opt,
+            mesh,
+            param_annotations(cfg),
+        )
+        state = init_fn(jax.random.PRNGKey(0), lambda k: init_params(k, cfg))
+        # w1 [L, embed(dim), mlp] must be sharded over fsdp and tp.
+        spec = state.params["layers"]["w1"].sharding.spec
+        assert tuple(spec) == (None, "fsdp", "tp")
+        # Optimizer state inherits the same layout (ZeRO-3 analog).
+        adam_mu = jax.tree.leaves(state.opt_state)
+        assert any(
+            getattr(leaf, "sharding", None) is not None
+            and leaf.sharding.spec == state.params["layers"]["w1"].sharding.spec
+            for leaf in adam_mu
+            if hasattr(leaf, "shape")
+            and leaf.shape == state.params["layers"]["w1"].shape
+        )
+
+    def test_sp_ring_attention_training(self):
+        """Sequence parallelism end-to-end: loss under ring attention
+        on an sp-sharded mesh matches the reference-attention loss."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = MeshSpec(sp=4).build(jax.devices()[:4])
+        cfg_ring = LlamaConfig.tiny(attention="ring")
+        cfg_ref = LlamaConfig.tiny(attention="reference")
+        params = init_params(jax.random.PRNGKey(0), cfg_ref)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 64), 0, cfg_ref.vocab_size
+        )
+        inp, tgt = toks[:, :-1], toks[:, 1:]  # seq 63... need divisible
+        inp, tgt = toks[:, :64][:, :-4], toks[:, 1:61]  # len 60 -> /4
+        ref_loss = float(loss_fn(params, inp, tgt, cfg_ref))
+
+        def sp_loss(params, inp, tgt):
+            b, t = inp.shape
+            positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+            def local(params, inp, tgt, positions):
+                return loss_fn(
+                    params, inp, tgt, cfg_ring,
+                    positions=positions, sp_axis="sp",
+                )[None]
+
+            losses = shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(P(), P(None, "sp"), P(None, "sp"), P(None, "sp")),
+                out_specs=P("sp"),
+                check_vma=False,
+            )(params, inp, tgt, positions)
+            # Each shard's mean is over its local tokens; all tokens
+            # unmasked and shards equal-sized, so the mean of means is
+            # the global mean.
+            return jnp.mean(losses)
+
+        ring_loss = float(sp_loss(params, inp, tgt))
+        np.testing.assert_allclose(ring_loss, ref_loss, rtol=2e-4)
+
+
+class TestJaxTrainer:
+    def test_fit_local_reports(self):
+        cfg = _tiny_cfg()
+
+        def train_loop(config):
+            mesh = MeshSpec(fsdp=1).build(jax.devices()[:1])
+            opt = default_optimizer(learning_rate=1e-2, total_steps=20)
+            init_fn, step_fn = make_train_step(
+                lambda p, t, y: loss_fn(p, t, y, cfg),
+                opt, mesh, param_annotations(cfg),
+            )
+            state = init_fn(
+                jax.random.PRNGKey(0), lambda k: init_params(k, cfg)
+            )
+            toks = jax.random.randint(
+                jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size
+            )
+            for step in range(config["steps"]):
+                state, metrics = step_fn(state, toks[:, :-1], toks[:, 1:])
+                report({"loss": float(metrics["loss"]), "step": step})
+
+        trainer = JaxTrainer(
+            train_loop,
+            train_loop_config={"steps": 3},
+            scaling_config=ScalingConfig(num_workers=1),
+        )
+        result = trainer.fit()
+        assert isinstance(result, Result)
+        assert result.error is None
+        assert len(result.metrics_history) == 3
+        assert result.metrics["step"] == 2
+
+    def test_fit_failure_captured(self):
+        def bad_loop():
+            raise RuntimeError("train loop exploded")
+
+        trainer = JaxTrainer(bad_loop)
+        result = trainer.fit()
+        assert result.error is not None
+        assert "exploded" in str(result.error)
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = {
+            "w": jnp.arange(16.0).reshape(4, 4),
+            "step": jnp.int32(7),
+        }
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(path, state, {"note": "test"})
+        restored = restore_checkpoint(
+            path, jax.tree.map(jnp.zeros_like, state)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        assert int(restored["step"]) == 7
+
+    def test_manager_retention(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), num_to_keep=2)
+        for step in [1, 2, 3]:
+            mgr.save(step, {"x": jnp.float32(step)})
+        dirs = sorted(os.listdir(tmp_path))
+        assert dirs == ["checkpoint_00000002", "checkpoint_00000003"]
+        assert mgr.latest().endswith("checkpoint_00000003")
+
+
+class TestWorkerGroup:
+    def test_gang_ranks(self):
+        import ray_tpu as rt
+
+        rt.init(num_cpus=4, ignore_reinit_error=True)
+        try:
+            from ray_tpu.train.worker_group import WorkerGroup
+
+            group = WorkerGroup(num_workers=2)
+
+            def whoami(tag):
+                return tag
+
+            outs = group.run_per_rank(
+                whoami, lambda rank: (f"worker-{rank}",)
+            )
+            assert outs == ["worker-0", "worker-1"]
+
+            def loop():
+                from ray_tpu.train.session import get_context, report
+
+                context = get_context()
+                report({"rank": context.world_rank})
+                return context.world_size
+
+            results = group.run_train_loop(loop)
+            assert [r["result"] for r in results] == [2, 2]
+            assert results[0]["reported"] == [{"rank": 0}]
+            assert results[1]["reported"] == [{"rank": 1}]
+            group.shutdown()
+        finally:
+            rt.shutdown()
