@@ -44,12 +44,14 @@ def _task_resources(options: Dict[str, Any], default_cpu: float) -> dict:
 
 def _export_cached(obj, cache_holder, attr: str, worker) -> str:
     """Export once per session: the cache is invalidated when the
-    worker changes (shutdown()+init() starts a fresh KV)."""
+    worker changes (shutdown()+init() starts a fresh KV). Keyed on the
+    worker's generation token so a module-level @remote function doesn't
+    pin a dead worker (and its RPC client) alive after shutdown()."""
     cached = getattr(cache_holder, attr)
-    if cached is not None and cached[0] is worker:
+    if cached is not None and cached[0] == worker.generation:
         return cached[1]
     key = worker.functions.export(obj)
-    setattr(cache_holder, attr, (worker, key))
+    setattr(cache_holder, attr, (worker.generation, key))
     return key
 
 

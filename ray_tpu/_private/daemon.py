@@ -88,6 +88,11 @@ class ActorRuntime:
     # as a group if the worker dies (reference: ActorTaskSubmitter
     # resends/fails unacked tasks on death).
     inflight: Dict[TaskID, dict] = field(default_factory=dict)
+    # Creation args stay pinned for the actor's restartable lifetime
+    # (restarts re-dispatch creation_spec); unpinned exactly once on
+    # permanent death (reference: lineage pinning keeps the creation
+    # task's args reachable while the actor may restart).
+    creation_unpinned: bool = False
 
 
 class NodeDaemon:
@@ -403,6 +408,15 @@ class NodeDaemon:
                 if kind == "ref":
                     self._ensure_entry(ObjectID(payload)).refcount += 1
 
+    def _unpin_creation_args(self, runtime: "ActorRuntime") -> None:
+        """Release an actor's creation-task args exactly once, when the
+        actor can no longer restart."""
+        with self._lock:
+            if runtime.creation_unpinned:
+                return
+            runtime.creation_unpinned = True
+        self._unpin_args(runtime.creation_spec)
+
     def _unpin_args(self, spec: dict) -> None:
         self._h_del_ref(
             None,
@@ -528,7 +542,16 @@ class NodeDaemon:
                         runtime.inflight.pop(task_id, None)
             else:
                 self.scheduler.release(task_id)
-            self._unpin_args(spec)
+            if spec["kind"] == "actor_creation":
+                # Creation args stay pinned while the actor may restart
+                # (restarts re-dispatch the same creation spec); a failed
+                # creation is permanent death, so release them.
+                with self._lock:
+                    runtime = self.actors.get(ActorID(spec["actor_id"]))
+                if error is not None and runtime is not None:
+                    self._unpin_creation_args(runtime)
+            else:
+                self._unpin_args(spec)
             with self._lock:
                 entry.state = "DONE"
         # Return the worker to the pool (actor workers stay pinned).
@@ -546,7 +569,15 @@ class NodeDaemon:
         for ret in spec["returns"]:
             self._seal_error(ObjectID(ret), payload)
         self._record_task_event(spec, "FAILED")
-        self._unpin_args(spec)
+        if spec["kind"] == "actor_creation":
+            with self._lock:
+                runtime = self.actors.get(ActorID(spec["actor_id"]))
+            if runtime is not None:
+                self._unpin_creation_args(runtime)
+            else:
+                self._unpin_args(spec)
+        else:
+            self._unpin_args(spec)
 
     def _h_cancel_task(self, conn, msg):
         task_id = TaskID(msg["task_id"])
@@ -671,6 +702,7 @@ class NodeDaemon:
         self.control.update_actor_state(
             actor_id, ACTOR_DEAD, death_cause=cause
         )
+        self._unpin_creation_args(runtime)
         for p in pending:
             self._fail_task_returns(p, "ActorDiedError", cause)
 
@@ -691,8 +723,14 @@ class NodeDaemon:
                 pass
         else:
             # No live worker: the creation task may still be queued —
-            # cancel it so the actor can't resurrect after the kill.
-            self.scheduler.cancel(creation_task)
+            # cancel it so the actor can't resurrect after the kill, and
+            # seal its return objects so waiters unblock with an error.
+            if self.scheduler.cancel(creation_task):
+                self._fail_task_returns(
+                    runtime.creation_spec,
+                    "ActorDiedError",
+                    "actor killed before creation",
+                )
             self._mark_actor_dead(actor_id, "killed via kill()")
         return {"ok": True}
 

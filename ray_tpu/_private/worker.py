@@ -16,6 +16,7 @@ memory and only seal notifications hit the daemon.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
@@ -77,9 +78,15 @@ class _TaskContext(threading.local):
         self.submit_index = 0
 
 
+_worker_generation = itertools.count()
+
+
 class CoreWorker:
     def __init__(self, socket_path: str, role: str = "driver"):
         self.role = role
+        # Unique per-process token for session-scoped caches (unlike
+        # id(), never reused after this worker is collected).
+        self.generation = next(_worker_generation)
         # Execution state must exist before the RPC client starts its
         # reader thread: the daemon may push execute_task immediately
         # after (even before) the register reply.

@@ -7,9 +7,12 @@ get_checkpoint:754 for restore)."""
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+_CKPT_MARKER = ".latest_checkpoint"
 
 
 @dataclass
@@ -38,8 +41,25 @@ class _Session:
             self.results.append(dict(metrics))
             if checkpoint is not None:
                 self.latest_checkpoint = checkpoint
+                self._persist_marker(checkpoint)
         if self._result_callback is not None:
             self._result_callback(metrics, checkpoint)
+
+    def _persist_marker(self, checkpoint: str) -> None:
+        """Record the latest checkpoint path in the trial dir so a
+        restarted attempt (trainer retry / resumed experiment) can
+        restore from it (reference: backend_executor._restart:759
+        resumes from the latest tracked checkpoint)."""
+        trial_dir = self.context.trial_dir
+        if not trial_dir:
+            return
+        tmp = os.path.join(trial_dir, _CKPT_MARKER + ".tmp")
+        try:
+            with open(tmp, "w") as f:
+                f.write(checkpoint)
+            os.replace(tmp, os.path.join(trial_dir, _CKPT_MARKER))
+        except OSError:
+            pass
 
 
 _session_holder = threading.local()
@@ -47,6 +67,15 @@ _session_holder = threading.local()
 
 def init_session(context: TrainContext, result_callback=None) -> _Session:
     session = _Session(context, result_callback)
+    if context.trial_dir:
+        marker = os.path.join(context.trial_dir, _CKPT_MARKER)
+        try:
+            with open(marker) as f:
+                path = f.read().strip()
+            if path and os.path.exists(path):
+                session.latest_checkpoint = path
+        except OSError:
+            pass
     _session_holder.session = session
     return session
 

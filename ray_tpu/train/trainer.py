@@ -49,10 +49,17 @@ class JaxTrainer:
     # -- public API (reference: BaseTrainer.fit, base_trainer.py:567) --
     def fit(self) -> Result:
         max_failures = self.run_config.failure_config.max_failures
+        name = self.run_config.name or "jax_trainer"
+        # One storage dir for all attempts: retries find the previous
+        # attempt's checkpoint marker there and resume from it.
+        storage = self.run_config.storage_path or tempfile.mkdtemp(
+            prefix=f"rt_train_{name}_"
+        )
+        os.makedirs(storage, exist_ok=True)
         attempt = 0
         while True:
             try:
-                return self._fit_once()
+                return self._fit_once(name, storage)
             except Exception as e:  # noqa: BLE001
                 attempt += 1
                 if attempt > max_failures:
@@ -62,12 +69,7 @@ class JaxTrainer:
                 traceback.print_exc()
 
     # ------------------------------------------------------------------
-    def _fit_once(self) -> Result:
-        name = self.run_config.name or "jax_trainer"
-        storage = self.run_config.storage_path or tempfile.mkdtemp(
-            prefix=f"rt_train_{name}_"
-        )
-        os.makedirs(storage, exist_ok=True)
+    def _fit_once(self, name: str, storage: str) -> Result:
         if self.scaling_config.num_workers <= 1:
             return self._fit_local(name, storage)
         return self._fit_gang(name, storage)
@@ -125,7 +127,7 @@ class JaxTrainer:
         try:
             self.backend.on_start(group, self.backend_config)
             outs = group.run_train_loop(
-                self._train_loop, name, self._loop_args()
+                self._train_loop, name, self._loop_args(), trial_dir=storage
             )
         finally:
             self.backend.on_shutdown(group)

@@ -28,7 +28,7 @@ class _TrainWorker:
     def run(self, fn, args=(), kwargs=None):
         return fn(*args, **(kwargs or {}))
 
-    def run_with_context(self, fn, experiment_name="", args=()):
+    def run_with_context(self, fn, experiment_name="", args=(), trial_dir=None):
         from .session import TrainContext, clear_session, init_session
 
         context = TrainContext(
@@ -36,6 +36,7 @@ class _TrainWorker:
             world_size=self.world_size,
             local_rank=self.rank,
             experiment_name=experiment_name,
+            trial_dir=trial_dir,
         )
         session = init_session(context)
         try:
@@ -84,9 +85,11 @@ class WorkerGroup:
         ]
         return rt.get(refs)
 
-    def run_train_loop(self, fn: Callable, experiment_name="", args=()):
+    def run_train_loop(
+        self, fn: Callable, experiment_name="", args=(), trial_dir=None
+    ):
         refs = [
-            w.run_with_context.remote(fn, experiment_name, args)
+            w.run_with_context.remote(fn, experiment_name, args, trial_dir)
             for w in self.workers
         ]
         return rt.get(refs)

@@ -245,6 +245,85 @@ def test_kill_actor():
         rt.get(v.ping.remote(), timeout=10)
 
 
+def test_actor_restart_keeps_creation_args_pinned():
+    """Creation args must survive the caller dropping its ObjectRef and
+    the first creation completing: restarts re-run the creation task
+    with the same args (reference: lineage pinning, reference_count.h)."""
+
+    @rt.remote(max_restarts=1)
+    class Holder:
+        def __init__(self, payload):
+            self.total = int(payload.sum())
+
+        def value(self):
+            return self.total
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    arr = np.ones(300_000, dtype=np.float32)  # large → real shm object
+    ref = rt.put(arr)
+    h = Holder.remote(ref)
+    assert rt.get(h.value.remote(), timeout=30) == 300_000
+    del ref  # caller handle drop must not delete the pinned arg
+    import gc
+
+    gc.collect()
+    with pytest.raises(
+        (exc.ActorDiedError, exc.ActorUnavailableError, exc.WorkerCrashedError)
+    ):
+        rt.get(h.die.remote(), timeout=30)
+    # After restart the creation arg was still available.
+    assert rt.get(h.value.remote(), timeout=30) == 300_000
+
+
+def test_kill_queued_actor_seals_creation_and_unpins():
+    """kill() of an actor whose creation task is still queued must fail
+    the creation returns and release pinned args (no object leak)."""
+    import time
+
+    @rt.remote
+    def blocker():
+        time.sleep(60)
+
+    arr = np.ones(300_000, dtype=np.float32)
+    ref = rt.put(arr)
+    blockers = [blocker.remote() for _ in range(4)]  # saturate 4 CPUs
+    time.sleep(0.3)
+
+    @rt.remote(num_cpus=1)
+    class Queued:
+        def __init__(self, payload):
+            self.payload = payload
+
+        def ping(self):
+            return 1
+
+    q = Queued.remote(ref)
+    time.sleep(0.3)
+    rt.kill(q)
+    with pytest.raises(
+        (exc.ActorDiedError, exc.ActorUnavailableError, exc.WorkerCrashedError)
+    ):
+        rt.get(q.ping.remote(), timeout=10)
+    # Dropping the caller's ref must now actually delete the object:
+    # the daemon's pin was released by the kill.
+    del ref
+    import gc
+
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        used = rt.state_summary().get("used", 0)
+        if used < arr.nbytes:
+            break
+        time.sleep(0.2)
+    assert used < arr.nbytes, f"creation arg leaked ({used} bytes in use)"
+    del blockers
+
+
 def test_cancel_queued_task():
     @rt.remote
     def blocker():

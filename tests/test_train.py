@@ -2,6 +2,7 @@
 mesh), JaxTrainer fit, sessions, checkpointing, worker gangs."""
 
 import os
+import pathlib
 
 import jax
 import jax.numpy as jnp
@@ -178,6 +179,48 @@ class TestJaxTrainer:
         result = trainer.fit()
         assert result.error is not None
         assert "exploded" in str(result.error)
+
+    def test_fit_retry_resumes_from_checkpoint(self, tmp_path):
+        """A retried attempt must restore from the previous attempt's
+        latest checkpoint, not restart from scratch (reference:
+        backend_executor._restart:759)."""
+        from ray_tpu.train import FailureConfig, get_checkpoint
+
+        marker = tmp_path / "attempts"
+        marker.write_text("0")
+
+        def loop():
+            attempt = int(marker.read_text())
+            marker.write_text(str(attempt + 1))
+            ckpt = get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                start = int(
+                    (pathlib.Path(ckpt) / "step").read_text()
+                )
+            assert not (attempt > 0 and start == 0), (
+                "retry did not see the previous attempt's checkpoint"
+            )
+            for step in range(start, 5):
+                d = tmp_path / f"ck{step}"
+                d.mkdir(exist_ok=True)
+                (d / "step").write_text(str(step + 1))
+                report({"step": step}, checkpoint=str(d))
+                if step == 2 and attempt == 0:
+                    raise RuntimeError("boom at step 2")
+
+        trainer = JaxTrainer(
+            loop,
+            run_config=RunConfig(
+                storage_path=str(tmp_path / "storage"),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["step"] == 4
+        # Second attempt resumed at step 3 → reported only steps 3, 4.
+        assert [m["step"] for m in result.metrics_history] == [3, 4]
 
 
 class TestCheckpoint:
