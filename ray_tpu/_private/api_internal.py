@@ -55,6 +55,12 @@ def _export_cached(obj, cache_holder, attr: str, worker) -> str:
     return key
 
 
+def _strategy(options: Dict[str, Any]):
+    from ..util.scheduling_strategies import strategy_to_spec
+
+    return strategy_to_spec(options.get("scheduling_strategy"))
+
+
 def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
     worker = _require_worker()
     opts = rf.task_options
@@ -67,6 +73,7 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
         num_returns=num_returns,
         resources=_task_resources(opts, default_cpu=1.0),
         max_retries=opts.get("max_retries", worker.config.task_max_retries),
+        scheduling_strategy=_strategy(opts),
     )
     return refs[0] if num_returns == 1 else refs
 
@@ -89,6 +96,7 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         resources=_task_resources(opts, default_cpu=0.0),
         max_restarts=opts.get("max_restarts", 0),
         handle_meta=meta,
+        scheduling_strategy=_strategy(opts),
     )
     return ActorHandle(actor_id, meta)
 

@@ -50,6 +50,11 @@ class Config:
     #: Hard cap on worker processes started per node. 0 = 4 * num_cpus.
     max_workers_per_node: int = 0
 
+    # ---- cluster ----
+    #: Seconds between node load-report heartbeats to the head
+    #: (reference: ray_syncer resource broadcast period).
+    heartbeat_interval_s: float = 0.25
+
     # ---- fault tolerance ----
     #: Default max retries for tasks (reference: task default 3).
     task_max_retries: int = 3

@@ -1,4 +1,4 @@
-"""Per-node daemon: local scheduler + worker pool + object directory,
+"""Per-node daemon: local scheduler + worker pool + object plane,
 with the control plane embedded on the head node.
 
 This process plays the role of the reference's raylet (reference:
@@ -9,11 +9,23 @@ worker_pool.cc:1312) and, on the head node, also the GCS server
 daemon replaces the reference's separate `gcs_server` binary; the
 tables are the same (`gcs.ControlState`).
 
+Topology: every node runs a `NodeDaemon`. The head (`is_head=True`)
+owns all control tables, object metadata (locations, refcounts), the
+cluster scheduler (policies.py), actor lifecycle decisions, and node
+health. Worker nodes (`is_head=False`, `head_address=...`) proxy
+control ops to the head, execute tasks forwarded by the head against
+their local worker pool, and serve/pull object data node-to-node
+(the reference's ObjectManager push/pull plane,
+src/ray/object_manager/object_manager.h, chunked per
+ray_config_def.h:341). Placement is decided centrally at the head from
+heartbeat-refreshed load views — the GCS-scheduling path of the
+reference rather than raylet spillback.
+
 Workers and drivers connect over a Unix socket (`rpc.RpcServer`).
-Large objects never pass through this process: clients write them
-straight into per-object shared memory and only the seal notification
-flows here (the plasma create/seal protocol,
-src/ray/object_manager/plasma/store.h).
+Large objects never pass through this process on the node that owns
+them: clients write them straight into per-object shared memory and
+only the seal notification flows here (the plasma create/seal
+protocol, src/ray/object_manager/plasma/store.h).
 """
 
 from __future__ import annotations
@@ -25,7 +37,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from .config import Config
 from .gcs import (
@@ -40,7 +52,8 @@ from .gcs import (
 )
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import SharedMemoryStore
-from .rpc import DEFERRED, Connection, RpcServer
+from .policies import NodeView, PlacementPolicy
+from .rpc import DEFERRED, Connection, RpcClient, RpcError, RpcServer
 from .scheduler import LocalScheduler, ResourceSet
 
 # Object entry states.
@@ -53,11 +66,16 @@ ERRORED = "ERRORED"
 class ObjectEntry:
     state: str = PENDING
     size: int = 0
-    inline: Optional[bytes] = None  # small objects live here
+    inline: Optional[bytes] = None  # small objects live here (head)
     error: Optional[bytes] = None  # serialized TaskError payload
-    in_shm: bool = False
-    refcount: int = 1
+    in_shm: bool = False  # data present in THIS node's store
+    refcount: int = 1  # head-only: owner refcount
     waiters: List[tuple] = field(default_factory=list)  # (conn, mid)
+    # head-only: which nodes hold a shm copy + meta subscribers.
+    locations: Set[bytes] = field(default_factory=set)
+    meta_waiters: List[tuple] = field(default_factory=list)
+    pulling: bool = False
+    reconstructing: bool = False
 
 
 @dataclass
@@ -76,22 +94,31 @@ class TaskEntry:
     spec: dict
     state: str = "PENDING"
     retries_left: int = 0
+    node: Optional[bytes] = None  # head-only: forwarded-to node
+
+
+@dataclass
+class ActorHost:
+    """Per-node hosting record: binds an actor to a local worker
+    (reference: the executing side of ActorTaskSubmitter — the worker
+    the creation task leased, transport/actor_task_submitter.h)."""
+
+    creation_spec: dict
+    worker_conn_id: Optional[int] = None
+    pending: deque = field(default_factory=deque)
+    inflight: Dict[TaskID, dict] = field(default_factory=dict)
 
 
 @dataclass
 class ActorRuntime:
+    """Head-side authoritative actor record (reference:
+    GcsActorManager state machine, design_docs/actor_states.rst)."""
+
     creation_spec: dict
     info: ActorInfo
-    worker_conn_id: Optional[int] = None
-    pending: deque = field(default_factory=deque)  # specs awaiting ALIVE
-    # Specs pushed to the actor's worker and not yet completed; failed
-    # as a group if the worker dies (reference: ActorTaskSubmitter
-    # resends/fails unacked tasks on death).
+    node: Optional[bytes] = None  # hosting node id
+    pending: deque = field(default_factory=deque)  # queued while !ALIVE
     inflight: Dict[TaskID, dict] = field(default_factory=dict)
-    # Creation args stay pinned for the actor's restartable lifetime
-    # (restarts re-dispatch creation_spec); unpinned exactly once on
-    # permanent death (reference: lineage pinning keeps the creation
-    # task's args reachable while the actor may restart).
     creation_unpinned: bool = False
 
 
@@ -102,23 +129,28 @@ class NodeDaemon:
         resources: Dict[str, float],
         config: Config,
         is_head: bool = True,
+        head_address: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.session_dir = session_dir
         self.config = config
+        self.is_head = is_head
         self.node_id = NodeID.from_random()
         self.socket_path = os.path.join(session_dir, "hostd.sock")
         os.makedirs(session_dir, exist_ok=True)
 
         capacity = config.object_store_memory or _default_store_bytes()
-        self.store = SharedMemoryStore(self.node_id.hex(), capacity)
-        self.control = ControlState(config.task_events_max_buffer)
+        self.store = SharedMemoryStore(
+            self.node_id.hex(), capacity, on_evict=self._on_store_evict
+        )
         self.scheduler = LocalScheduler(ResourceSet(resources))
         self.resources = dict(resources)
+        self.labels = dict(labels or {})
 
         self._lock = threading.RLock()
         self.objects: Dict[ObjectID, ObjectEntry] = {}
         self.tasks: Dict[TaskID, TaskEntry] = {}
-        self.actors: Dict[ActorID, ActorRuntime] = {}
+        self.actor_hosts: Dict[ActorID, ActorHost] = {}
         self.workers: Dict[int, WorkerInfo] = {}  # conn_id -> info
         self.drivers: Dict[int, JobID] = {}  # conn_id -> job
         self._spawning = 0
@@ -131,14 +163,20 @@ class NodeDaemon:
         )
         self._max_workers = max_workers
 
-        self.control.register_node(
-            NodeInfo(
-                node_id=self.node_id,
-                address=self.socket_path,
-                resources=dict(resources),
-                is_head=is_head,
-            )
+        # Head-only state.
+        self.control: Optional[ControlState] = None
+        self.actor_runtimes: Dict[ActorID, ActorRuntime] = {}
+        self._policy = PlacementPolicy(
+            config.scheduler_spread_threshold,
+            config.scheduler_top_k_fraction,
         )
+        self._infeasible: Dict[TaskID, dict] = {}  # spec by task id
+        self._node_clients: Dict[bytes, RpcClient] = {}
+        self._node_conns: Dict[int, bytes] = {}  # conn_id -> node_id
+        # Node-only state.
+        self.head: Optional[RpcClient] = None
+        self._peer_clients: Dict[str, RpcClient] = {}  # address -> client
+        self._hb_thread: Optional[threading.Thread] = None
 
         self.server = RpcServer(self.socket_path)
         for name in [
@@ -168,12 +206,58 @@ class NodeDaemon:
             "list_nodes",
             "list_actors",
             "ping",
+            # object data plane (all nodes)
+            "pull_object",
+            "delete_object",
+            # head control plane (worker nodes call these on the head)
+            "register_node",
+            "node_heartbeat",
+            "get_object_meta",
+            "task_finished",
+            "actor_created",
+            "actor_worker_died",
+            "object_evicted",
+            # head -> node forwards
+            "schedule_task",
+            "actor_task",
+            "kill_actor_local",
+            "cancel_local",
         ]:
             self.server.register(name, getattr(self, "_h_" + name))
         self.server.register("_disconnect", self._h_disconnect)
 
+        if is_head:
+            self.control = ControlState(config.task_events_max_buffer)
+            self.control.register_node(
+                NodeInfo(
+                    node_id=self.node_id,
+                    address=self.socket_path,
+                    resources=dict(resources),
+                    labels=self.labels,
+                    is_head=True,
+                    available=dict(resources),
+                )
+            )
+        else:
+            assert head_address, "worker node needs head_address"
+            self.head_address = head_address
+
     def start(self) -> None:
         self.server.start()
+        if not self.is_head:
+            self.head = RpcClient(self.head_address)
+            self.head.call(
+                "register_node",
+                node_id=self.node_id.binary(),
+                address=self.socket_path,
+                resources=self.resources,
+                labels=self.labels,
+            )
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"hb:{self.node_id.hex()[:8]}",
+            )
+            self._hb_thread.start()
 
     # ------------------------------------------------------------------
     # registration / lifecycle
@@ -200,6 +284,13 @@ class NodeDaemon:
                 "config": self.config.to_dict(),
             }
         # driver
+        if not self.is_head:
+            # Drivers attach to the head, which owns job state. (The
+            # reference lets drivers attach to any raylet; divergence
+            # documented in SURVEY §7 — centralized control plane.)
+            raise RuntimeError(
+                "drivers must connect to the head node address"
+            )
         job_id = self.control.next_job_id()
         self.control.add_job(
             JobInfo(
@@ -219,10 +310,65 @@ class NodeDaemon:
             "config": self.config.to_dict(),
         }
 
+    def _h_register_node(self, conn, msg):
+        """A worker-node daemon joins the cluster (head only)."""
+        node_id = NodeID(msg["node_id"])
+        self.control.register_node(
+            NodeInfo(
+                node_id=node_id,
+                address=msg["address"],
+                resources=dict(msg["resources"]),
+                labels=dict(msg.get("labels") or {}),
+                available=dict(msg["resources"]),
+            )
+        )
+        conn.metadata["role"] = "node"
+        with self._lock:
+            self._node_conns[conn.conn_id] = node_id.binary()
+        self._retry_infeasible()
+        return {"ok": True}
+
+    def _h_node_heartbeat(self, conn, msg):
+        node_id = NodeID(msg["node_id"])
+        info = self.control.nodes.get(node_id)
+        if info is not None:
+            info.last_heartbeat = time.time()
+            info.available = dict(msg.get("available") or {})
+            info.queued = int(msg.get("queued", 0))
+        # Parked tasks (forward raced a node death, or no feasible node
+        # yet) get another placement attempt on the heartbeat tick.
+        with self._lock:
+            any_parked = bool(self._infeasible)
+        if any_parked:
+            self._retry_infeasible()
+        return {"ok": True}
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self.head.call(
+                    "node_heartbeat",
+                    node_id=self.node_id.binary(),
+                    available=self.scheduler.available().to_dict(),
+                    queued=self.scheduler.queued_count(),
+                )
+            except Exception:
+                if self._shutdown:
+                    return
+            time.sleep(self.config.heartbeat_interval_s)
+
     def _h_disconnect(self, conn: Connection, msg: dict):
+        if self._shutdown:
+            # Dying daemons must not report their own worker kills as
+            # task failures — the head's node-death path owns recovery.
+            return {}
         with self._lock:
             winfo = self.workers.pop(conn.conn_id, None)
             self.drivers.pop(conn.conn_id, None)
+            dead_node = self._node_conns.pop(conn.conn_id, None)
+        if dead_node is not None:
+            self._on_node_death(dead_node)
+            return {}
         if winfo is None:
             return {}
         # Worker died (reference: raylet detects worker death via the
@@ -237,10 +383,40 @@ class NodeDaemon:
         return {"ok": True, "node_id": self.node_id.binary()}
 
     # ------------------------------------------------------------------
+    # node clients (head->node forwards, node->node pulls)
+    # ------------------------------------------------------------------
+    def _node_client(self, node_id: bytes) -> Optional[RpcClient]:
+        with self._lock:
+            client = self._node_clients.get(node_id)
+        if client is not None:
+            return client
+        info = self.control.nodes.get(NodeID(node_id))
+        if info is None or not info.alive:
+            return None
+        client = RpcClient(info.address)
+        with self._lock:
+            self._node_clients[node_id] = client
+        return client
+
+    def _peer_client(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._peer_clients.get(address)
+        if client is None:
+            client = RpcClient(address)
+            with self._lock:
+                self._peer_clients[address] = client
+        return client
+
+    # ------------------------------------------------------------------
     # KV (function/actor-class blobs — reference: GcsKvManager +
     # function_manager.py export/fetch protocol)
     # ------------------------------------------------------------------
     def _h_kv_put(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "kv_put", ns=msg.get("ns", ""), key=msg["key"],
+                value=msg["value"], overwrite=msg.get("overwrite", True),
+            )
         added = self.control.kv_put(
             msg.get("ns", ""), msg["key"], msg["value"],
             overwrite=msg.get("overwrite", True),
@@ -248,9 +424,18 @@ class NodeDaemon:
         return {"added": added}
 
     def _h_kv_get(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "kv_get", ns=msg.get("ns", ""), key=msg["key"]
+            )
         return {"value": self.control.kv_get(msg.get("ns", ""), msg["key"])}
 
     def _h_kv_keys(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "kv_keys", ns=msg.get("ns", ""),
+                prefix=msg.get("prefix", ""),
+            )
         return {
             "keys": self.control.kv_keys(
                 msg.get("ns", ""), msg.get("prefix", "")
@@ -258,7 +443,7 @@ class NodeDaemon:
         }
 
     # ------------------------------------------------------------------
-    # objects
+    # objects — metadata (head) + local data plane (all nodes)
     # ------------------------------------------------------------------
     def _ensure_entry(self, oid: ObjectID) -> ObjectEntry:
         entry = self.objects.get(oid)
@@ -268,51 +453,77 @@ class NodeDaemon:
         return entry
 
     def _h_put_inline(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "put_inline", oid=msg["oid"], data=msg["data"]
+            )
         oid = ObjectID(msg["oid"])
         with self._lock:
             entry = self._ensure_entry(oid)
             entry.inline = msg["data"]
             entry.size = len(msg["data"])
             entry.state = SEALED
-            waiters = entry.waiters
-            entry.waiters = []
-        self._wake(oid, waiters)
+        self._wake(oid)
         self._schedule()
         return {}
 
     def _h_object_sealed(self, conn, msg):
+        """A shm object was sealed. From a local worker: record the
+        local copy (and, on worker nodes, tell the head). From a node
+        daemon (head only): record the remote location."""
         oid = ObjectID(msg["oid"])
+        source_node = msg.get("node_id")  # set when a node reports
         with self._lock:
             entry = self._ensure_entry(oid)
             entry.size = msg["size"]
-            entry.in_shm = True
             entry.state = SEALED
-            waiters = entry.waiters
-            entry.waiters = []
-        self._wake(oid, waiters)
+            if source_node is None:
+                entry.in_shm = True  # sealed by a local client
+            if self.is_head:
+                entry.locations.add(source_node or self.node_id.binary())
+        if not self.is_head and source_node is None:
+            # Report our copy to the head's object directory.
+            self.head.call(
+                "object_sealed", oid=msg["oid"], size=msg["size"],
+                node_id=self.node_id.binary(),
+            )
+        self._wake(oid)
         self._schedule()
         return {}
 
     def _h_seal_error(self, conn, msg):
-        oid = ObjectID(msg["oid"])
-        self._seal_error(oid, msg["error"])
+        if not self.is_head:
+            reply = self.head.call(
+                "seal_error", oid=msg["oid"], error=msg["error"]
+            )
+            # Also fail local waiters (workers blocked on this node).
+            self._seal_error_local(ObjectID(msg["oid"]), msg["error"])
+            self._schedule()  # errored deps count as resolved
+            return reply
+        self._seal_error_local(ObjectID(msg["oid"]), msg["error"])
         self._schedule()
         return {}
 
     def _seal_error(self, oid: ObjectID, error: bytes) -> None:
+        """Mark an object as errored in the authoritative table."""
+        if not self.is_head:
+            try:
+                self.head.call(
+                    "seal_error", oid=oid.binary(), error=error
+                )
+            except RpcError:
+                pass
+        self._seal_error_local(oid, error)
+
+    def _seal_error_local(self, oid: ObjectID, error: bytes) -> None:
         with self._lock:
             entry = self._ensure_entry(oid)
             entry.error = error
             entry.state = ERRORED
-            waiters = entry.waiters
-            entry.waiters = []
-        self._wake(oid, waiters)
+        self._wake(oid)
 
-    def _wake(self, oid: ObjectID, waiters: List[tuple]) -> None:
-        for conn, mid in waiters:
-            conn.reply(mid, self._object_reply(oid))
-
-    def _object_reply(self, oid: ObjectID) -> dict:
+    def _object_reply_local(self, oid: ObjectID) -> Optional[dict]:
+        """Reply for a local consumer, or None if data must be pulled."""
         with self._lock:
             entry = self.objects.get(oid)
             if entry is None or entry.state == PENDING:
@@ -321,7 +532,34 @@ class NodeDaemon:
                 return {"error": entry.error}
             if entry.inline is not None:
                 return {"inline": entry.inline}
-            return {"shm_size": entry.size}
+            if entry.in_shm:
+                return {"shm_size": entry.size}
+        return None  # sealed, data elsewhere
+
+    def _wake(self, oid: ObjectID) -> None:
+        """Wake waiters that can now be answered; re-arm data waiters
+        whose object is sealed but remote (pull in progress)."""
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is None:
+                return
+            waiters = entry.waiters
+            entry.waiters = []
+            meta_waiters = entry.meta_waiters
+            entry.meta_waiters = []
+        for conn, mid in meta_waiters:
+            conn.reply(mid, self._meta_reply(oid))
+        needs_pull = False
+        for conn, mid in waiters:
+            reply = self._object_reply_local(oid)
+            if reply is None:
+                with self._lock:
+                    entry.waiters.append((conn, mid))
+                needs_pull = True
+            else:
+                conn.reply(mid, reply)
+        if needs_pull:
+            self._ensure_local(oid)
 
     def _h_get_object(self, conn, msg):
         oid = ObjectID(msg["oid"])
@@ -329,10 +567,260 @@ class NodeDaemon:
             entry = self._ensure_entry(oid)
             if entry.state == PENDING:
                 entry.waiters.append((conn, msg["_mid"]))
-                return DEFERRED
-        return self._object_reply(oid)
+                if not self.is_head:
+                    pull_needed = not entry.pulling
+                else:
+                    pull_needed = False
+            else:
+                pull_needed = False
+        if pull_needed:
+            # On worker nodes PENDING may just mean "not local yet":
+            # ask the head (blocks until sealed) then pull.
+            self._ensure_local(oid)
+            return DEFERRED
+        if entry.state == PENDING:
+            return DEFERRED
+        reply = self._object_reply_local(oid)
+        if reply is None:
+            with self._lock:
+                entry.waiters.append((conn, msg["_mid"]))
+            self._ensure_local(oid)
+            return DEFERRED
+        return reply
 
+    def _meta_reply(self, oid: ObjectID) -> dict:
+        """Metadata view served to node daemons (head only)."""
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is None or entry.state == PENDING:
+                return {"pending": True}
+            if entry.state == ERRORED:
+                return {"error": entry.error}
+            if entry.inline is not None:
+                return {"inline": entry.inline}
+            locations = []
+            for nid in entry.locations:
+                info = self.control.nodes.get(NodeID(nid))
+                if info is not None and info.alive:
+                    locations.append((nid, info.address))
+            return {"size": entry.size, "locations": locations}
+
+    def _h_get_object_meta(self, conn, msg):
+        oid = ObjectID(msg["oid"])
+        with self._lock:
+            entry = self._ensure_entry(oid)
+            if entry.state == PENDING:
+                entry.meta_waiters.append((conn, msg["_mid"]))
+                return DEFERRED
+        reply = self._meta_reply(oid)
+        if reply.get("size") is not None and not reply["locations"]:
+            # All copies lost: try lineage reconstruction, keep waiting.
+            with self._lock:
+                entry.meta_waiters.append((conn, msg["_mid"]))
+            self._maybe_reconstruct(oid)
+            return DEFERRED
+        return reply
+
+    def _h_pull_object(self, conn, msg):
+        """Serve a chunk of a locally-stored object (reference:
+        PushManager chunking, object_manager/push_manager.h)."""
+        oid = ObjectID(msg["oid"])
+        offset = msg.get("offset", 0)
+        length = msg.get("length", self.config.object_transfer_chunk_size)
+        with self._lock:
+            entry = self.objects.get(oid)
+            size = entry.size if entry is not None and entry.in_shm else None
+        view = self.store.get(oid, timeout=0.1)
+        if view is None and size is not None:
+            # Segment was created directly by a local worker process;
+            # attach by name (plasma clients mmap by object id).
+            try:
+                view = self.store.open_remote(oid, size)
+            except FileNotFoundError:
+                view = None
+        if view is None:
+            return {"missing": True}
+        total = len(view)
+        chunk = bytes(view[offset : min(offset + length, total)])
+        return {"data": chunk, "total_size": total}
+
+    def _h_delete_object(self, conn, msg):
+        """Head tells this node to drop its copy (refcount hit zero)."""
+        oid = ObjectID(msg["oid"])
+        with self._lock:
+            self.objects.pop(oid, None)
+        # unlink_by_id also reaches segments created directly by local
+        # worker processes (the daemon never attached them).
+        self.store.unlink_by_id(oid)
+        return {}
+
+    def _h_object_evicted(self, conn, msg):
+        """A node evicted a cached copy under memory pressure."""
+        oid = ObjectID(msg["oid"])
+        node_id = msg["node_id"]
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                entry.locations.discard(node_id)
+        return {}
+
+    def _on_store_evict(self, oid: ObjectID) -> None:
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                entry.in_shm = False
+        if self.is_head:
+            with self._lock:
+                if entry is not None:
+                    entry.locations.discard(self.node_id.binary())
+        elif self.head is not None:
+            try:
+                self.head.notify(
+                    "object_evicted", oid=oid.binary(),
+                    node_id=self.node_id.binary(),
+                )
+            except Exception:
+                pass
+
+    # -- cross-node pull -------------------------------------------------
+    def _ensure_local(self, oid: ObjectID) -> None:
+        """Asynchronously make a sealed object's data local to this
+        node (reference: PullManager, object_manager/pull_manager.h)."""
+        with self._lock:
+            entry = self._ensure_entry(oid)
+            if entry.pulling or entry.in_shm or entry.inline is not None:
+                return
+            if entry.state == ERRORED:
+                return
+            entry.pulling = True
+        threading.Thread(
+            target=self._pull_worker, args=(oid,), daemon=True,
+            name=f"pull:{oid.hex()[:8]}",
+        ).start()
+
+    def _pull_worker(self, oid: ObjectID) -> None:
+        try:
+            self._pull_once(oid)
+        finally:
+            with self._lock:
+                entry = self.objects.get(oid)
+                if entry is not None:
+                    entry.pulling = False
+            self._wake(oid)
+            self._schedule()
+
+    def _pull_once(self, oid: ObjectID) -> None:
+        for attempt in range(5):
+            if self.is_head:
+                meta = self._meta_reply(oid)
+            else:
+                try:
+                    meta = self.head.call(
+                        "get_object_meta", oid=oid.binary()
+                    )
+                except RpcError:
+                    return
+            if meta.get("error") is not None:
+                self._seal_error_local(oid, meta["error"])
+                return
+            if meta.get("inline") is not None:
+                with self._lock:
+                    entry = self._ensure_entry(oid)
+                    entry.inline = meta["inline"]
+                    entry.size = len(meta["inline"])
+                    entry.state = SEALED
+                return
+            if meta.get("pending"):
+                # Head path only (node meta call blocks until sealed):
+                # object not produced yet; waiters stay armed.
+                return
+            size = meta["size"]
+            locations = [
+                (nid, addr)
+                for nid, addr in meta["locations"]
+                if nid != self.node_id.binary()
+            ]
+            if not locations:
+                if self.is_head:
+                    self._maybe_reconstruct(oid)
+                    return
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            nid, addr = locations[0]
+            client = (
+                self._node_client(nid) if self.is_head
+                else self._peer_client(addr)
+            )
+            if client is None:
+                continue
+            if self._pull_chunks(client, oid, size):
+                with self._lock:
+                    entry = self._ensure_entry(oid)
+                    entry.in_shm = True
+                    entry.size = size
+                    entry.state = SEALED
+                    if self.is_head:
+                        entry.locations.add(self.node_id.binary())
+                if not self.is_head:
+                    try:
+                        self.head.call(
+                            "object_sealed", oid=oid.binary(), size=size,
+                            node_id=self.node_id.binary(),
+                        )
+                    except RpcError:
+                        pass
+                return
+        # Exhausted retries: leave waiters armed; a future seal or
+        # location report re-wakes them.
+
+    def _pull_chunks(self, client: RpcClient, oid: ObjectID, size: int) -> bool:
+        if self.store.contains(oid):
+            return True
+        chunk_size = self.config.object_transfer_chunk_size
+        try:
+            buf = self.store.create(oid, size)
+        except ValueError:
+            return True  # concurrent pull won
+        except Exception:
+            return False
+        offset = 0
+        try:
+            while offset < size:
+                reply = client.call(
+                    "pull_object", oid=oid.binary(), offset=offset,
+                    length=chunk_size, timeout=30.0,
+                )
+                if reply.get("missing"):
+                    raise RpcError("source no longer has object")
+                data = reply["data"]
+                if not data:
+                    raise RpcError("empty chunk")
+                buf[offset : offset + len(data)] = data
+                offset += len(data)
+        except Exception:
+            self.store.delete(oid)
+            return False
+        self.store.seal(oid)
+        return True
+
+    # -- wait ------------------------------------------------------------
     def _h_wait_objects(self, conn, msg):
+        if not self.is_head:
+            mid = msg["_mid"]
+
+            def proxy():
+                try:
+                    reply = self.head.call(
+                        "wait_objects", oids=msg["oids"],
+                        num_returns=msg["num_returns"],
+                        wait_timeout=msg.get("wait_timeout"),
+                    )
+                except RpcError as e:
+                    reply = {"_error": str(e)}
+                conn.reply(mid, reply)
+
+            threading.Thread(target=proxy, daemon=True).start()
+            return DEFERRED
         oids = [ObjectID(b) for b in msg["oids"]]
         num_returns = msg["num_returns"]
         timeout = msg.get("wait_timeout")
@@ -369,13 +857,20 @@ class NodeDaemon:
         check_and_reply()
         return DEFERRED
 
+    # -- refcounting -----------------------------------------------------
     def _h_add_ref(self, conn, msg):
+        if not self.is_head:
+            self.head.notify("add_ref", oids=msg["oids"])
+            return {}
         with self._lock:
             for b in msg["oids"]:
                 self._ensure_entry(ObjectID(b)).refcount += 1
         return {}
 
     def _h_del_ref(self, conn, msg):
+        if not self.is_head:
+            self.head.notify("del_ref", oids=msg["oids"])
+            return {}
         to_delete = []
         with self._lock:
             for b in msg["oids"]:
@@ -385,18 +880,29 @@ class NodeDaemon:
                     continue
                 entry.refcount -= 1
                 if entry.refcount <= 0 and entry.state != PENDING:
-                    to_delete.append((oid, entry.in_shm))
+                    remote_locs = [
+                        nid for nid in entry.locations
+                        if nid != self.node_id.binary()
+                    ]
+                    to_delete.append((oid, entry.in_shm, remote_locs))
                     del self.objects[oid]
-        for oid, in_shm in to_delete:
+        for oid, in_shm, remote_locs in to_delete:
             # Clients create segments directly; the daemon owns unlink.
             if in_shm:
                 self.store.unlink_by_id(oid)
             else:
                 self.store.delete(oid)
+            for nid in remote_locs:
+                client = self._node_client(nid)
+                if client is not None:
+                    try:
+                        client.notify("delete_object", oid=oid.binary())
+                    except Exception:
+                        pass
         return {}
 
     # ------------------------------------------------------------------
-    # tasks
+    # task pinning helpers (head only — the head owns refcounts)
     # ------------------------------------------------------------------
     def _pin_args(self, spec: dict) -> None:
         """Hold a reference on every ObjectRef argument for the task's
@@ -408,7 +914,7 @@ class NodeDaemon:
                 if kind == "ref":
                     self._ensure_entry(ObjectID(payload)).refcount += 1
 
-    def _unpin_creation_args(self, runtime: "ActorRuntime") -> None:
+    def _unpin_creation_args(self, runtime: ActorRuntime) -> None:
         """Release an actor's creation-task args exactly once, when the
         actor can no longer restart."""
         with self._lock:
@@ -429,8 +935,96 @@ class NodeDaemon:
             },
         )
 
+    # ------------------------------------------------------------------
+    # task submission + cluster placement (head)
+    # ------------------------------------------------------------------
+    def _node_views(self) -> List[NodeView]:
+        views = []
+        mine = self.node_id.binary()
+        for info in self.control.alive_nodes():
+            nid = info.node_id.binary()
+            if nid == mine:
+                avail = self.scheduler.available()
+            else:
+                avail = ResourceSet(info.available)
+            views.append(
+                NodeView(
+                    node_id=nid,
+                    total=ResourceSet(info.resources),
+                    available=avail,
+                    labels=info.labels,
+                    is_local=(nid == mine),
+                )
+            )
+        return views
+
+    def _submit_cluster(self, spec: dict) -> None:
+        """Place a task spec on a node (head only). Infeasible specs
+        wait for the cluster to change (reference: tasks queue until
+        resources exist)."""
+        task_id = TaskID(spec["task_id"])
+        request = ResourceSet(spec.get("resources", {}))
+        target = self._policy.pick(
+            self._node_views(), request, spec.get("scheduling_strategy")
+        )
+        if target is None:
+            with self._lock:
+                self._infeasible[task_id] = spec
+            self._record_task_event(spec, "PENDING_NODE_ASSIGNMENT")
+            return
+        with self._lock:
+            entry = self.tasks.get(task_id)
+            if entry is not None:
+                entry.node = target
+            if spec["kind"] == "actor_creation":
+                runtime = self.actor_runtimes.get(ActorID(spec["actor_id"]))
+                if runtime is not None:
+                    runtime.node = target
+        if target == self.node_id.binary():
+            self._record_task_event(spec, "PENDING_ARGS_AVAIL")
+            if spec["kind"] == "actor_creation":
+                with self._lock:
+                    aid = ActorID(spec["actor_id"])
+                    self.actor_hosts.setdefault(aid, ActorHost(spec))
+            self.scheduler.enqueue(task_id, request, spec)
+            self._schedule()
+            return
+        client = self._node_client(target)
+        if client is None:
+            self._park_infeasible(task_id, spec)
+            return
+        self._record_task_event(spec, "FORWARDED")
+        try:
+            client.call("schedule_task", spec=spec)
+        except RpcError:
+            # Node just died. Clear the assignment so the node-death
+            # orphan scan can't also resubmit it (double execution).
+            self._park_infeasible(task_id, spec)
+
+    def _park_infeasible(self, task_id: TaskID, spec: dict) -> None:
+        with self._lock:
+            entry = self.tasks.get(task_id)
+            if entry is not None:
+                entry.node = None
+            self._infeasible[task_id] = spec
+
+    def _retry_infeasible(self) -> None:
+        with self._lock:
+            pending = [
+                (tid, spec)
+                for tid, spec in self._infeasible.items()
+                if not (
+                    tid in self.tasks and self.tasks[tid].state == "DONE"
+                )
+            ]
+            self._infeasible.clear()
+        for _, spec in pending:
+            self._submit_cluster(spec)
+
     def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
+        if not self.is_head:
+            return self.head.call("submit_task", spec=spec)
         task_id = TaskID(spec["task_id"])
         with self._lock:
             self.tasks[task_id] = TaskEntry(
@@ -439,15 +1033,55 @@ class NodeDaemon:
             for ret in spec["returns"]:
                 self._ensure_entry(ObjectID(ret))
         self._pin_args(spec)
-        self._record_task_event(spec, "PENDING_ARGS_AVAIL")
+        self._submit_cluster(spec)
+        return {}
+
+    def _h_schedule_task(self, conn, msg):
+        """Head forwarded a task to run on this node."""
+        spec = msg["spec"]
+        task_id = TaskID(spec["task_id"])
+        with self._lock:
+            self.tasks[task_id] = TaskEntry(
+                spec=spec, retries_left=spec.get("max_retries", 0)
+            )
+            if spec["kind"] == "actor_creation":
+                aid = ActorID(spec["actor_id"])
+                self.actor_hosts.setdefault(aid, ActorHost(spec))
         self.scheduler.enqueue(
             task_id, ResourceSet(spec.get("resources", {})), spec
         )
         self._schedule()
         return {}
 
+    def _h_task_finished(self, conn, msg):
+        """A node reports final task completion (head only).
+        Idempotent: a task already finalized (e.g. failed via
+        _fail_task_returns) is not unpinned twice."""
+        task_id = TaskID(msg["task_id"])
+        with self._lock:
+            entry = self.tasks.get(task_id)
+            if entry is None or entry.state == "DONE":
+                return {}
+            entry.state = "DONE"
+        spec = entry.spec
+        self._record_task_event(
+            spec, "FAILED" if msg.get("had_error") else "FINISHED"
+        )
+        if spec["kind"] == "actor_task":
+            with self._lock:
+                runtime = self.actor_runtimes.get(ActorID(spec["actor_id"]))
+                if runtime is not None:
+                    runtime.inflight.pop(task_id, None)
+        self._unpin_args(spec)
+        return {}
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
     def _h_create_actor(self, conn, msg):
         spec = msg["spec"]
+        if not self.is_head:
+            return self.head.call("create_actor", spec=spec)
         actor_id = ActorID(spec["actor_id"])
         info = ActorInfo(
             actor_id=actor_id,
@@ -459,7 +1093,7 @@ class NodeDaemon:
         )
         self.control.register_actor(info)
         with self._lock:
-            self.actors[actor_id] = ActorRuntime(
+            self.actor_runtimes[actor_id] = ActorRuntime(
                 creation_spec=spec, info=info
             )
             task_id = TaskID(spec["task_id"])
@@ -467,18 +1101,17 @@ class NodeDaemon:
             for ret in spec["returns"]:
                 self._ensure_entry(ObjectID(ret))
         self._pin_args(spec)
-        self.scheduler.enqueue(
-            task_id, ResourceSet(spec.get("resources", {})), spec
-        )
-        self._schedule()
+        self._submit_cluster(spec)
         return {}
 
     def _h_submit_actor_task(self, conn, msg):
         spec = msg["spec"]
+        if not self.is_head:
+            return self.head.call("submit_actor_task", spec=spec)
         actor_id = ActorID(spec["actor_id"])
         task_id = TaskID(spec["task_id"])
         with self._lock:
-            runtime = self.actors.get(actor_id)
+            runtime = self.actor_runtimes.get(actor_id)
             self.tasks[task_id] = TaskEntry(
                 spec=spec, retries_left=spec.get("max_retries", 0)
             )
@@ -490,17 +1123,56 @@ class NodeDaemon:
                 spec, "ActorDiedError", "actor is dead"
             )
             return {}
+        self._route_actor_task(runtime, spec)
+        return {}
+
+    def _route_actor_task(self, runtime: ActorRuntime, spec: dict) -> None:
+        """Deliver an actor task to its hosting node, or queue while the
+        actor is pending/restarting (head only)."""
+        task_id = TaskID(spec["task_id"])
         with self._lock:
-            if (
-                runtime.info.state == ACTOR_ALIVE
-                and runtime.worker_conn_id in self.workers
-            ):
-                worker = self.workers[runtime.worker_conn_id]
-                runtime.inflight[task_id] = spec
+            if runtime.info.state != ACTOR_ALIVE or runtime.node is None:
+                runtime.pending.append(spec)
+                return
+            runtime.inflight[task_id] = spec
+            target = runtime.node
+        if target == self.node_id.binary():
+            self._host_push_task(ActorID(spec["actor_id"]), spec)
+            return
+        client = self._node_client(target)
+        if client is None:
+            self._fail_task_returns(
+                spec, "ActorUnavailableError", "actor node unreachable"
+            )
+            return
+        try:
+            client.call("actor_task", spec=spec)
+        except RpcError:
+            self._fail_task_returns(
+                spec, "ActorUnavailableError", "actor node unreachable"
+            )
+
+    def _h_actor_task(self, conn, msg):
+        """Head forwards an actor task to this hosting node."""
+        spec = msg["spec"]
+        self._host_push_task(ActorID(spec["actor_id"]), spec)
+        return {}
+
+    def _host_push_task(self, actor_id: ActorID, spec: dict) -> None:
+        with self._lock:
+            host = self.actor_hosts.get(actor_id)
+            if host is None:
+                host = self.actor_hosts.setdefault(actor_id, ActorHost(spec))
+            worker = (
+                self.workers.get(host.worker_conn_id)
+                if host.worker_conn_id is not None
+                else None
+            )
+            if worker is not None:
+                host.inflight[TaskID(spec["task_id"])] = spec
                 worker.conn.push("execute_task", {"spec": spec})
             else:
-                runtime.pending.append(spec)
-        return {}
+                host.pending.append(spec)
 
     def _h_task_done(self, conn, msg):
         task_id = TaskID(msg["task_id"])
@@ -526,32 +1198,32 @@ class NodeDaemon:
             if error is not None:
                 for ret in spec["returns"]:
                     self._seal_error(ObjectID(ret), error)
-                self._record_task_event(spec, "FAILED")
-            else:
-                self._record_task_event(spec, "FINISHED")
             if spec["kind"] == "actor_creation":
-                self._on_actor_created(spec, error, conn.conn_id)
+                self._on_actor_created_host(spec, error, conn.conn_id)
                 if error is not None:
                     self.scheduler.release(task_id)
                 # else: a live actor holds its creation resources until
-                # death (_on_actor_worker_death / _mark_actor_dead).
+                # death (_on_actor_worker_death / actor death handling).
             elif spec["kind"] == "actor_task":
                 with self._lock:
-                    runtime = self.actors.get(ActorID(spec["actor_id"]))
-                    if runtime is not None:
-                        runtime.inflight.pop(task_id, None)
+                    host = self.actor_hosts.get(ActorID(spec["actor_id"]))
+                    if host is not None:
+                        host.inflight.pop(task_id, None)
             else:
                 self.scheduler.release(task_id)
-            if spec["kind"] == "actor_creation":
-                # Creation args stay pinned while the actor may restart
-                # (restarts re-dispatch the same creation spec); a failed
-                # creation is permanent death, so release them.
-                with self._lock:
-                    runtime = self.actors.get(ActorID(spec["actor_id"]))
-                if error is not None and runtime is not None:
-                    self._unpin_creation_args(runtime)
-            else:
-                self._unpin_args(spec)
+            # Final-completion bookkeeping lives on the head.
+            if spec["kind"] != "actor_creation":
+                if self.is_head:
+                    self._h_task_finished(
+                        None,
+                        {"task_id": msg["task_id"], "had_error": error is not None},
+                    )
+                else:
+                    self.head.notify(
+                        "task_finished",
+                        task_id=msg["task_id"],
+                        had_error=error is not None,
+                    )
             with self._lock:
                 entry.state = "DONE"
         # Return the worker to the pool (actor workers stay pinned).
@@ -569,9 +1241,17 @@ class NodeDaemon:
         for ret in spec["returns"]:
             self._seal_error(ObjectID(ret), payload)
         self._record_task_event(spec, "FAILED")
+        if not self.is_head:
+            return
+        with self._lock:
+            entry = self.tasks.get(TaskID(spec["task_id"]))
+            if entry is not None:
+                if entry.state == "DONE":
+                    return  # already finalized; don't unpin twice
+                entry.state = "DONE"
         if spec["kind"] == "actor_creation":
             with self._lock:
-                runtime = self.actors.get(ActorID(spec["actor_id"]))
+                runtime = self.actor_runtimes.get(ActorID(spec["actor_id"]))
             if runtime is not None:
                 self._unpin_creation_args(runtime)
             else:
@@ -580,8 +1260,26 @@ class NodeDaemon:
             self._unpin_args(spec)
 
     def _h_cancel_task(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("cancel_task", task_id=msg["task_id"])
         task_id = TaskID(msg["task_id"])
         cancelled = self.scheduler.cancel(task_id)
+        if not cancelled:
+            with self._lock:
+                entry = self.tasks.get(task_id)
+                target = entry.node if entry is not None else None
+                if task_id in self._infeasible:
+                    del self._infeasible[task_id]
+                    cancelled = True
+            if not cancelled and target and target != self.node_id.binary():
+                client = self._node_client(target)
+                if client is not None:
+                    try:
+                        cancelled = client.call(
+                            "cancel_local", task_id=msg["task_id"]
+                        )["cancelled"]
+                    except RpcError:
+                        cancelled = False
         if cancelled:
             with self._lock:
                 entry = self.tasks.get(task_id)
@@ -591,157 +1289,323 @@ class NodeDaemon:
                 )
         return {"cancelled": cancelled}
 
-    # ------------------------------------------------------------------
-    # actors
-    # ------------------------------------------------------------------
-    def _on_actor_created(
+    def _h_cancel_local(self, conn, msg):
+        task_id = TaskID(msg["task_id"])
+        return {"cancelled": self.scheduler.cancel(task_id)}
+
+    # -- host-side actor lifecycle --------------------------------------
+    def _on_actor_created_host(
         self, spec: dict, error, worker_conn_id: int
     ) -> None:
         actor_id = ActorID(spec["actor_id"])
         with self._lock:
-            runtime = self.actors.get(actor_id)
-            if runtime is None:
-                return
-            if runtime.info.state == ACTOR_DEAD:
-                # Killed while the creation task was queued/running: do
-                # not resurrect; release the worker back to the pool.
-                worker = self.workers.get(worker_conn_id)
-                if worker is not None:
-                    worker.pinned_actor = None
-                if error is None and worker is not None:
-                    # The instance was constructed; recycle the process
-                    # so actor state can't leak into later tasks.
-                    try:
-                        os.kill(worker.pid, 9)
-                    except ProcessLookupError:
-                        pass
+            host = self.actor_hosts.get(actor_id)
+            if host is None:
                 return
             if error is not None:
-                runtime.info.state = ACTOR_DEAD
-                self.control.update_actor_state(
-                    actor_id, ACTOR_DEAD, death_cause="creation task failed"
-                )
-                pending = list(runtime.pending)
-                runtime.pending.clear()
-                # Unpin so _h_task_done returns this worker to the pool.
+                self.actor_hosts.pop(actor_id, None)
                 worker = self.workers.get(worker_conn_id)
                 if worker is not None:
                     worker.pinned_actor = None
             else:
-                runtime.info.state = ACTOR_ALIVE
-                runtime.worker_conn_id = worker_conn_id
-                self.control.update_actor_state(
-                    actor_id, ACTOR_ALIVE, node_id=self.node_id
-                )
+                host.worker_conn_id = worker_conn_id
                 worker = self.workers.get(worker_conn_id)
-                worker.current_task = None
-                worker.pinned_actor = actor_id
-                pending = []
-                while runtime.pending:
-                    queued = runtime.pending.popleft()
-                    runtime.inflight[TaskID(queued["task_id"])] = queued
+                if worker is not None:
+                    worker.current_task = None
+                    worker.pinned_actor = actor_id
+                while host.pending:
+                    queued = host.pending.popleft()
+                    host.inflight[TaskID(queued["task_id"])] = queued
                     worker.conn.push("execute_task", {"spec": queued})
-        for p in pending:
-            self._fail_task_returns(
-                p, "ActorDiedError", "actor creation failed"
+        self._control_actor_created(
+            actor_id, error is not None, self.node_id.binary()
+        )
+
+    def _control_actor_created(
+        self, actor_id: ActorID, failed: bool, node_id: bytes
+    ) -> None:
+        if not self.is_head:
+            try:
+                self.head.call(
+                    "actor_created", actor_id=actor_id.binary(),
+                    failed=failed, node_id=node_id,
+                )
+            except RpcError:
+                pass
+            return
+        self._h_actor_created(
+            None,
+            {
+                "actor_id": actor_id.binary(),
+                "failed": failed,
+                "node_id": node_id,
+            },
+        )
+
+    def _h_actor_created(self, conn, msg):
+        """Creation-task outcome reaches the control plane (head)."""
+        actor_id = ActorID(msg["actor_id"])
+        failed = msg["failed"]
+        node_id = msg["node_id"]
+        with self._lock:
+            runtime = self.actor_runtimes.get(actor_id)
+            if runtime is None:
+                return {}
+            if runtime.info.state == ACTOR_DEAD:
+                # Killed while the creation task was queued/running: do
+                # not resurrect; recycle the hosting worker so actor
+                # state can't leak into later tasks.
+                if not failed:
+                    self._kill_host_worker(actor_id, node_id)
+                return {}
+            if failed:
+                runtime.info.state = ACTOR_DEAD
+                pending = list(runtime.pending)
+                runtime.pending.clear()
+            else:
+                runtime.info.state = ACTOR_ALIVE
+                runtime.node = node_id
+                pending = []
+        if failed:
+            self.control.update_actor_state(
+                actor_id, ACTOR_DEAD, death_cause="creation task failed"
             )
+            for p in pending:
+                self._fail_task_returns(
+                    p, "ActorDiedError", "actor creation failed"
+                )
+            self._unpin_creation_args(runtime)
+        else:
+            self.control.update_actor_state(
+                actor_id, ACTOR_ALIVE, node_id=NodeID(node_id)
+            )
+            while True:
+                with self._lock:
+                    if not runtime.pending:
+                        break
+                    spec = runtime.pending.popleft()
+                self._route_actor_task(runtime, spec)
+        return {}
+
+    def _kill_host_worker(self, actor_id: ActorID, node_id: bytes) -> None:
+        """Kill the worker process hosting an actor (post-kill cleanup
+        when creation finished after kill())."""
+        if node_id == self.node_id.binary():
+            with self._lock:
+                host = self.actor_hosts.pop(actor_id, None)
+                worker = (
+                    self.workers.get(host.worker_conn_id)
+                    if host and host.worker_conn_id is not None
+                    else None
+                )
+                if worker is not None:
+                    worker.pinned_actor = None
+            if worker is not None:
+                try:
+                    os.kill(worker.pid, 9)
+                except ProcessLookupError:
+                    pass
+            return
+        client = self._node_client(node_id)
+        if client is not None:
+            try:
+                client.call("kill_actor_local", actor_id=actor_id.binary())
+            except RpcError:
+                pass
 
     def _on_actor_worker_death(self, winfo: WorkerInfo) -> None:
         actor_id = winfo.pinned_actor
         with self._lock:
-            runtime = self.actors.get(actor_id)
+            host = self.actor_hosts.pop(actor_id, None)
+        creating = (
+            winfo.current_task is not None
+            and host is not None
+            and host.worker_conn_id is None
+        )
+        if host is not None:
+            creation_task = TaskID(host.creation_spec["task_id"])
+            self.scheduler.release(creation_task)
+        if not self.is_head:
+            try:
+                self.head.call(
+                    "actor_worker_died", actor_id=actor_id.binary(),
+                    creating=creating,
+                )
+            except RpcError:
+                pass
+            return
+        self._h_actor_worker_died(
+            None, {"actor_id": actor_id.binary(), "creating": creating}
+        )
+
+    def _h_actor_worker_died(self, conn, msg):
+        """Hosting worker died; decide restart vs. death (head)."""
+        actor_id = ActorID(msg["actor_id"])
+        creating = msg.get("creating", False)
+        with self._lock:
+            runtime = self.actor_runtimes.get(actor_id)
             if runtime is None:
-                return
+                return {}
             can_restart = (
                 runtime.info.max_restarts == -1
                 or runtime.info.num_restarts < runtime.info.max_restarts
             ) and not self._shutdown
             inflight = list(runtime.inflight.values())
             runtime.inflight.clear()
-            creating = (
-                self.tasks.get(winfo.current_task)
-                if runtime.info.state == ACTOR_PENDING_CREATION
-                and winfo.current_task is not None
-                else None
-            )
         for spec in inflight:
             self._fail_task_returns(
                 spec,
                 "ActorUnavailableError" if can_restart else "ActorDiedError",
                 "actor worker died while executing task",
             )
-        if creating is not None and not can_restart:
+        if creating and not can_restart:
             self._fail_task_returns(
-                creating.spec, "ActorDiedError", "actor died during creation"
+                runtime.creation_spec,
+                "ActorDiedError",
+                "actor died during creation",
             )
-        creation_task = TaskID(runtime.creation_spec["task_id"])
-        self.scheduler.release(creation_task)
         if can_restart:
             with self._lock:
                 runtime.info.num_restarts += 1
                 runtime.info.state = ACTOR_RESTARTING
-                runtime.worker_conn_id = None
+                runtime.node = None
             self.control.update_actor_state(actor_id, ACTOR_RESTARTING)
-            self.scheduler.enqueue(
-                creation_task,
-                ResourceSet(runtime.creation_spec.get("resources", {})),
-                runtime.creation_spec,
-            )
-            self._schedule()
+            spec = runtime.creation_spec
+            task_id = TaskID(spec["task_id"])
+            with self._lock:
+                self.tasks[task_id] = TaskEntry(spec=spec)
+            self._submit_cluster(spec)
+            with self._lock:
+                entry = self.tasks.get(task_id)
+                runtime.node = entry.node if entry else None
         else:
             self._mark_actor_dead(actor_id, "worker died")
+        return {}
 
     def _mark_actor_dead(self, actor_id: ActorID, cause: str) -> None:
         with self._lock:
-            runtime = self.actors.get(actor_id)
+            runtime = self.actor_runtimes.get(actor_id)
             if runtime is None:
                 return
             runtime.info.state = ACTOR_DEAD
             pending = list(runtime.pending)
             runtime.pending.clear()
+            inflight = list(runtime.inflight.values())
+            runtime.inflight.clear()
         self.control.update_actor_state(
             actor_id, ACTOR_DEAD, death_cause=cause
         )
         self._unpin_creation_args(runtime)
-        for p in pending:
+        for p in pending + inflight:
             self._fail_task_returns(p, "ActorDiedError", cause)
 
     def _h_kill_actor(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "kill_actor", actor_id=msg["actor_id"],
+                no_restart=msg.get("no_restart", True),
+            )
         actor_id = ActorID(msg["actor_id"])
         with self._lock:
-            runtime = self.actors.get(actor_id)
+            runtime = self.actor_runtimes.get(actor_id)
             if runtime is None:
                 return {"ok": False}
             if msg.get("no_restart", True):
                 runtime.info.max_restarts = 0  # suppress restart
-            winfo = self.workers.get(runtime.worker_conn_id)
+            target = runtime.node
             creation_task = TaskID(runtime.creation_spec["task_id"])
+            infeasible = creation_task in self._infeasible
+            if infeasible:
+                del self._infeasible[creation_task]
+        if infeasible:
+            self._fail_task_returns(
+                runtime.creation_spec,
+                "ActorDiedError",
+                "actor killed before creation",
+            )
+            self._mark_actor_dead(actor_id, "killed via kill()")
+            return {"ok": True}
+        if target is None or target == self.node_id.binary():
+            self._kill_actor_local(actor_id)
+        else:
+            client = self._node_client(target)
+            if client is not None:
+                try:
+                    client.call(
+                        "kill_actor_local", actor_id=actor_id.binary()
+                    )
+                except RpcError:
+                    self._mark_actor_dead(actor_id, "actor node unreachable")
+        return {"ok": True}
+
+    def _h_kill_actor_local(self, conn, msg):
+        self._kill_actor_local(ActorID(msg["actor_id"]))
+        return {"ok": True}
+
+    def _kill_actor_local(self, actor_id: ActorID) -> None:
+        """Kill the local hosting worker, or cancel a still-queued
+        creation task (then report death to the control plane)."""
+        with self._lock:
+            host = self.actor_hosts.get(actor_id)
+            winfo = (
+                self.workers.get(host.worker_conn_id)
+                if host and host.worker_conn_id is not None
+                else None
+            )
         if winfo is not None:
             try:
                 os.kill(winfo.pid, 9)
             except ProcessLookupError:
                 pass
-        else:
-            # No live worker: the creation task may still be queued —
-            # cancel it so the actor can't resurrect after the kill, and
-            # seal its return objects so waiters unblock with an error.
+            return
+        if host is not None:
+            creation_task = TaskID(host.creation_spec["task_id"])
             if self.scheduler.cancel(creation_task):
+                with self._lock:
+                    self.actor_hosts.pop(actor_id, None)
                 self._fail_task_returns(
-                    runtime.creation_spec,
+                    host.creation_spec,
                     "ActorDiedError",
                     "actor killed before creation",
                 )
+                if self.is_head:
+                    self._mark_actor_dead(actor_id, "killed via kill()")
+                else:
+                    try:
+                        self.head.call(
+                            "actor_worker_died",
+                            actor_id=actor_id.binary(),
+                            creating=False,
+                        )
+                    except RpcError:
+                        pass
+                return
+        # Creation running (worker not yet bound): fall back to marking
+        # dead at the control plane; the bind-time check recycles it.
+        if self.is_head:
             self._mark_actor_dead(actor_id, "killed via kill()")
-        return {"ok": True}
+        else:
+            try:
+                self.head.call(
+                    "actor_worker_died", actor_id=actor_id.binary(),
+                    creating=False,
+                )
+            except RpcError:
+                pass
 
     def _h_get_named_actor(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "get_named_actor", name=msg["name"],
+                namespace=msg.get("namespace", "default"),
+            )
         info = self.control.get_named_actor(
             msg.get("namespace", "default"), msg["name"]
         )
         if info is None:
             return {"found": False}
         with self._lock:
-            runtime = self.actors.get(info.actor_id)
+            runtime = self.actor_runtimes.get(info.actor_id)
         return {
             "found": True,
             "actor_id": info.actor_id.binary(),
@@ -752,16 +1616,129 @@ class NodeDaemon:
         }
 
     def _h_get_actor_info(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("get_actor_info", actor_id=msg["actor_id"])
         actor_id = ActorID(msg["actor_id"])
         with self._lock:
-            runtime = self.actors.get(actor_id)
+            runtime = self.actor_runtimes.get(actor_id)
         if runtime is None:
             return {"found": False}
         return {
             "found": True,
             "state": runtime.info.state,
             "num_restarts": runtime.info.num_restarts,
+            "node_id": NodeID(runtime.node).hex() if runtime.node else None,
         }
+
+    # ------------------------------------------------------------------
+    # node death (head)
+    # ------------------------------------------------------------------
+    def _on_node_death(self, node_id: bytes) -> None:
+        """Handle a worker node's death: drop locations, retry its
+        tasks, restart its actors (reference: GcsNodeManager death
+        broadcast + lineage reconstruction,
+        object_recovery_manager.h:90)."""
+        if self._shutdown:
+            return
+        self.control.mark_node_dead(NodeID(node_id))
+        with self._lock:
+            client = self._node_clients.pop(node_id, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+        # 1. Object copies on the dead node are gone.
+        lost_waiting = []
+        with self._lock:
+            for oid, entry in self.objects.items():
+                if node_id in entry.locations:
+                    entry.locations.discard(node_id)
+                    if (
+                        not entry.locations
+                        and not entry.in_shm
+                        and entry.inline is None
+                        and entry.state == SEALED
+                        and (entry.waiters or entry.meta_waiters)
+                    ):
+                        lost_waiting.append(oid)
+        for oid in lost_waiting:
+            self._maybe_reconstruct(oid)
+        # 2. Tasks forwarded to the dead node: retry elsewhere or fail.
+        with self._lock:
+            orphans = [
+                (tid, e)
+                for tid, e in self.tasks.items()
+                if e.node == node_id and e.state != "DONE"
+                and e.spec["kind"] == "normal"
+            ]
+        for tid, entry in orphans:
+            if entry.retries_left > 0:
+                entry.retries_left -= 1
+                self._record_task_event(entry.spec, "RETRY")
+                self._submit_cluster(entry.spec)
+            else:
+                self._fail_task_returns(
+                    entry.spec, "WorkerCrashedError", "node died"
+                )
+        # 3. Actors hosted on the dead node: restart or die.
+        with self._lock:
+            dead_actors = [
+                aid
+                for aid, rt in self.actor_runtimes.items()
+                if rt.node == node_id
+                and rt.info.state in (
+                    ACTOR_ALIVE, ACTOR_PENDING_CREATION, ACTOR_RESTARTING
+                )
+            ]
+        for aid in dead_actors:
+            self._h_actor_worker_died(
+                None, {"actor_id": aid.binary(), "creating": True}
+            )
+
+    def _maybe_reconstruct(self, oid: ObjectID) -> None:
+        """Lineage reconstruction: resubmit the task that created a
+        lost object (reference: ObjectRecoveryManager::ReconstructObject
+        — same task id ⇒ same return ids). Args must still be reachable;
+        if they were already released the object is lost for good."""
+        task_id = oid.task_id()
+        with self._lock:
+            entry = self.objects.get(oid)
+            task = self.tasks.get(task_id)
+            if entry is None:
+                return
+            if entry.reconstructing or entry.in_shm or entry.inline is not None:
+                return
+            if entry.state == PENDING:
+                return  # already resubmitted (or never produced yet)
+            args_gone = task is not None and any(
+                kind == "ref" and ObjectID(payload) not in self.objects
+                for kind, payload in task.spec["args"]
+            )
+            if task is None or task.spec["kind"] != "normal" or args_gone:
+                from .task_spec import make_error_payload
+
+                payload = make_error_payload(
+                    "ObjectLostError",
+                    f"object {oid.hex()} lost (all copies gone) and its "
+                    "lineage is not reconstructable (creating task "
+                    "unknown or its arguments already released)",
+                )
+            else:
+                payload = None
+                entry.reconstructing = True
+                entry.state = PENDING
+                entry.in_shm = False
+                entry.locations.clear()
+                task.state = "PENDING"
+        if payload is not None:
+            self._seal_error_local(oid, payload)
+            return
+        self._record_task_event(task.spec, "RECONSTRUCTING")
+        self._pin_args(task.spec)
+        self._submit_cluster(task.spec)
+        with self._lock:
+            entry.reconstructing = False
 
     # ------------------------------------------------------------------
     # scheduling + worker pool
@@ -772,12 +1749,25 @@ class NodeDaemon:
         self.scheduler.maybe_dispatch(self._deps_ready, self._try_dispatch)
 
     def _deps_ready(self, spec: dict) -> bool:
+        missing = []
         with self._lock:
             for kind, payload in spec["args"]:
                 if kind == "ref":
-                    entry = self.objects.get(ObjectID(payload))
+                    oid = ObjectID(payload)
+                    entry = self.objects.get(oid)
                     if entry is None or entry.state == PENDING:
-                        return False
+                        if not self.is_head:
+                            missing.append(oid)
+                        else:
+                            return False
+                    elif entry.state == SEALED and not (
+                        entry.in_shm or entry.inline is not None
+                    ):
+                        missing.append(oid)
+        if missing:
+            for oid in missing:
+                self._ensure_local(oid)
+            return False
         return True
 
     def _try_dispatch(self, task_id: TaskID, spec: dict) -> bool:
@@ -903,34 +1893,65 @@ class NodeDaemon:
             self._fail_task_returns(
                 entry.spec, "WorkerCrashedError", "worker process died"
             )
+            if entry.spec["kind"] != "actor_creation" and not self.is_head:
+                self.head.notify(
+                    "task_finished",
+                    task_id=entry.spec["task_id"],
+                    had_error=True,
+                )
 
     # ------------------------------------------------------------------
     # introspection / state API
     # ------------------------------------------------------------------
     def _h_cluster_resources(self, conn, msg):
-        return {"resources": self.scheduler.total().to_dict()}
+        if not self.is_head:
+            return self.head.call("cluster_resources")
+        total = ResourceSet()
+        for info in self.control.alive_nodes():
+            total = total.add(ResourceSet(info.resources))
+        return {"resources": total.to_dict()}
 
     def _h_available_resources(self, conn, msg):
-        return {"resources": self.scheduler.available().to_dict()}
+        if not self.is_head:
+            return self.head.call("available_resources")
+        total = ResourceSet()
+        mine = self.node_id.binary()
+        for info in self.control.alive_nodes():
+            if info.node_id.binary() == mine:
+                total = total.add(self.scheduler.available())
+            else:
+                total = total.add(ResourceSet(info.available))
+        return {"resources": total.to_dict()}
 
     def _h_state_summary(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("state_summary")
         summary = self.control.summary()
         summary.update(self.store.size_info())
         with self._lock:
             summary["workers"] = len(self.workers)
             summary["queued_tasks"] = self.scheduler.queued_count()
+            summary["infeasible_tasks"] = len(self._infeasible)
         return {"summary": summary}
 
     def _h_list_task_events(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "list_task_events", limit=msg.get("limit", 1000)
+            )
         return {"events": self.control.list_task_events(msg.get("limit", 1000))}
 
     def _h_list_nodes(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("list_nodes")
         return {
             "nodes": [
                 {
                     "node_id": n.node_id.hex(),
                     "address": n.address,
                     "resources": n.resources,
+                    "available": n.available,
+                    "labels": n.labels,
                     "alive": n.alive,
                     "is_head": n.is_head,
                 }
@@ -939,23 +1960,28 @@ class NodeDaemon:
         }
 
     def _h_list_actors(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("list_actors")
         with self._lock:
             return {
                 "actors": [
                     {
-                        "actor_id": a.info.actor_id.hex(),
-                        "name": a.info.name,
-                        "state": a.info.state,
-                        "class_name": a.info.class_name,
-                        "num_restarts": a.info.num_restarts,
+                        "actor_id": rt.info.actor_id.hex(),
+                        "name": rt.info.name,
+                        "state": rt.info.state,
+                        "class_name": rt.info.class_name,
+                        "num_restarts": rt.info.num_restarts,
+                        "node_id": NodeID(rt.node).hex() if rt.node else None,
                     }
-                    for a in self.actors.values()
+                    for rt in self.actor_runtimes.values()
                 ]
             }
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:
             return
+        if not self.is_head:
+            return  # head records events from task_finished reports
         self.control.add_task_event(
             {
                 "task_id": spec["task_id"].hex()
@@ -980,6 +2006,18 @@ class NodeDaemon:
             try:
                 proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
+                pass
+        if self.head is not None:
+            try:
+                self.head.close()
+            except Exception:
+                pass
+        for client in list(self._node_clients.values()) + list(
+            self._peer_clients.values()
+        ):
+            try:
+                client.close()
+            except Exception:
                 pass
         self.server.close()
         # Reclaim every live shared-memory object of the session.

@@ -39,6 +39,10 @@ class NodeInfo:
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.time)
     is_head: bool = False
+    # Load view refreshed by heartbeats (reference: ray_syncer resource
+    # gossip feeding ClusterResourceManager).
+    available: Dict[str, float] = field(default_factory=dict)
+    queued: int = 0
 
 
 @dataclass

@@ -94,13 +94,16 @@ class SharedMemoryStore:
     the same pages. Objects are immutable after seal.
     """
 
-    def __init__(self, node_id_hex: str, capacity: int):
+    def __init__(self, node_id_hex: str, capacity: int, on_evict=None):
         self._prefix = f"rt_{node_id_hex[:8]}_"
         self._capacity = capacity
         self._used = 0
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._lock = threading.RLock()
         self._seal_events: Dict[ObjectID, threading.Event] = {}
+        # Called with each evicted ObjectID so the owning daemon can fix
+        # its object table / tell the control plane a copy is gone.
+        self._on_evict = on_evict
 
     # -- producer side ---------------------------------------------------
     def create(self, object_id: ObjectID, size: int) -> memoryview:
@@ -180,6 +183,9 @@ class SharedMemoryStore:
                 self._entries[object_id] = _Entry(
                     shm=shm, size=size, sealed=True, created_at=time.time()
                 )
+                # Attached segments count against capacity the same as
+                # created ones — delete()/evict subtract them later.
+                self._used += size
         return shm.buf[:size]
 
     # -- lifetime --------------------------------------------------------
@@ -243,6 +249,7 @@ class SharedMemoryStore:
             for oid, e in self._entries.items()
             if e.sealed and e.pinned == 0
         ]
+        evicted = []
         for oid in victims:
             if freed >= bytes_needed:
                 break
@@ -254,6 +261,13 @@ class SharedMemoryStore:
             except FileNotFoundError:
                 pass
             _close_shm(entry.shm)
+            evicted.append(oid)
+        if self._on_evict is not None:
+            for oid in evicted:
+                try:
+                    self._on_evict(oid)
+                except Exception:
+                    pass
 
     def _name(self, object_id: ObjectID) -> str:
         return self._prefix + object_id.hex()

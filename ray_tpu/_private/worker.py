@@ -272,6 +272,7 @@ class CoreWorker:
         num_returns: int = 1,
         resources: Optional[Dict[str, float]] = None,
         max_retries: int = 0,
+        scheduling_strategy: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
         returns = [
@@ -287,6 +288,7 @@ class CoreWorker:
             "returns": [r.binary() for r in returns],
             "resources": resources or {"CPU": 1.0},
             "max_retries": max_retries,
+            "scheduling_strategy": scheduling_strategy,
         }
         self._client.call("submit_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
@@ -301,6 +303,7 @@ class CoreWorker:
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
         handle_meta: Optional[dict] = None,
+        scheduling_strategy: Optional[dict] = None,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
@@ -318,6 +321,7 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
             "handle_meta": handle_meta,
+            "scheduling_strategy": scheduling_strategy,
         }
         self._client.call("create_actor", spec=spec)
         return actor_id

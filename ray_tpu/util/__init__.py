@@ -1,0 +1,11 @@
+"""Utility APIs (reference: python/ray/util/)."""
+
+from .scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)
+
+__all__ = [
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]
