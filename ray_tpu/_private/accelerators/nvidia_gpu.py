@@ -1,0 +1,31 @@
+"""NVIDIA GPU manager (reference:
+python/ray/_private/accelerators/nvidia_gpu.py). Present so mixed
+clusters (CPU/GPU hosts driving TPU slices) schedule correctly; the
+TPU path never uses it."""
+
+from __future__ import annotations
+
+import glob
+from functools import lru_cache
+
+from .base import AcceleratorManager
+
+
+class NvidiaGPUAcceleratorManager(AcceleratorManager):
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "CUDA_VISIBLE_DEVICES"
+
+    @staticmethod
+    @lru_cache()
+    def get_current_node_num_accelerators() -> int:
+        import os
+
+        override = os.environ.get("RT_NUM_GPUS")
+        if override is not None:
+            return int(override)
+        return len(glob.glob("/proc/driver/nvidia/gpus/*"))

@@ -61,19 +61,59 @@ def _strategy(options: Dict[str, Any]):
     return strategy_to_spec(options.get("scheduling_strategy"))
 
 
+def _resolve_placement(
+    options: Dict[str, Any], resources: dict, worker: CoreWorker
+):
+    """Rewrite a placement-group-targeted request onto the group's
+    formatted resources (reference: BundleSpecification formatted
+    resources; the scheduler then needs no PG special-casing).
+
+    A task running inside a capturing group submits children that
+    inherit the group (wildcard bundle) unless they name their own
+    strategy (reference: placement_group_capture_child_tasks,
+    actor.py:890). Returns (resources, strategy_spec, pg_context).
+    """
+    from .placement_groups import rewrite_request
+
+    spec = _strategy(options)
+    if not spec and options.get("scheduling_strategy") is None:
+        inherited = worker.current_pg_context()
+        if inherited is not None:
+            rewritten = rewrite_request(resources, inherited["pg_id"], -1)
+            return rewritten, {"type": "DEFAULT"}, inherited
+    if not spec or spec.get("type") != "PLACEMENT_GROUP":
+        return resources, spec, None
+    rewritten = rewrite_request(
+        resources, spec["pg_id"], spec.get("bundle_index", -1)
+    )
+    pg_context = (
+        {"pg_id": spec["pg_id"]} if spec.get("capture") else None
+    )
+    return rewritten, {"type": "DEFAULT"}, pg_context
+
+
 def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
     worker = _require_worker()
     opts = rf.task_options
     func_key = _export_cached(rf.underlying, rf, "_exported_key", worker)
     num_returns = opts.get("num_returns", 1)
+    resources = _task_resources(opts, default_cpu=1.0)
+    pg_context = None
+    if opts.get("_skip_pg_rewrite"):
+        strategy = _strategy(opts)
+    else:
+        resources, strategy, pg_context = _resolve_placement(
+            opts, resources, worker
+        )
     refs = worker.submit_task(
         func_key,
         _flatten_args(args, kwargs),
         name=rf.underlying.__name__,
         num_returns=num_returns,
-        resources=_task_resources(opts, default_cpu=1.0),
+        resources=resources,
         max_retries=opts.get("max_retries", worker.config.task_max_retries),
-        scheduling_strategy=_strategy(opts),
+        scheduling_strategy=strategy,
+        pg_context=pg_context,
     )
     return refs[0] if num_returns == 1 else refs
 
@@ -87,16 +127,20 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         "methods": ac.method_names(),
         "class_key": class_key,
     }
+    resources, strategy, pg_context = _resolve_placement(
+        opts, _task_resources(opts, default_cpu=0.0), worker
+    )
     actor_id = worker.create_actor(
         class_key,
         _flatten_args(args, kwargs),
         class_name=ac.underlying.__name__,
         name=opts.get("name"),
         namespace=opts.get("namespace", "default"),
-        resources=_task_resources(opts, default_cpu=0.0),
+        resources=resources,
         max_restarts=opts.get("max_restarts", 0),
         handle_meta=meta,
-        scheduling_strategy=_strategy(opts),
+        scheduling_strategy=strategy,
+        pg_context=pg_context,
     )
     return ActorHandle(actor_id, meta)
 

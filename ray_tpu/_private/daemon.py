@@ -50,8 +50,22 @@ from .gcs import (
     JobInfo,
     NodeInfo,
 )
-from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
 from .object_store import SharedMemoryStore
+from .placement_groups import (
+    PGEntry,
+    STRATEGIES,
+    group_resources,
+    place_bundles,
+)
 from .policies import NodeView, PlacementPolicy
 from .rpc import DEFERRED, Connection, RpcClient, RpcError, RpcServer
 from .scheduler import LocalScheduler, ResourceSet
@@ -173,6 +187,16 @@ class NodeDaemon:
         self._infeasible: Dict[TaskID, dict] = {}  # spec by task id
         self._node_clients: Dict[bytes, RpcClient] = {}
         self._node_conns: Dict[int, bytes] = {}  # conn_id -> node_id
+        # Placement groups: head-side registry + node-side reserved
+        # bundles ((pg_id, index) -> {"resources", "committed"}).
+        self.pgs: Dict[bytes, PGEntry] = {}
+        self._bundles: Dict[tuple, dict] = {}
+        # Serializes the 2PC against concurrent retries/removals
+        # (reentrant: a local commit inside the 2PC may re-enter
+        # scheduling); the non-blocking gate stops _schedule()-driven
+        # retries from recursing (place -> commit -> _schedule -> place).
+        self._pg_mutex = threading.RLock()
+        self._pg_retry_gate = threading.Lock()
         # Node-only state.
         self.head: Optional[RpcClient] = None
         self._peer_clients: Dict[str, RpcClient] = {}  # address -> client
@@ -209,6 +233,14 @@ class NodeDaemon:
             # object data plane (all nodes)
             "pull_object",
             "delete_object",
+            # placement groups (API on head; bundle 2PC on all nodes)
+            "create_placement_group",
+            "remove_placement_group",
+            "placement_group_state",
+            "placement_group_table",
+            "prepare_bundle",
+            "commit_bundle",
+            "release_bundle",
             # head control plane (worker nodes call these on the head)
             "register_node",
             "node_heartbeat",
@@ -325,6 +357,7 @@ class NodeDaemon:
         conn.metadata["role"] = "node"
         with self._lock:
             self._node_conns[conn.conn_id] = node_id.binary()
+        self._retry_pending_pgs()
         self._retry_infeasible()
         return {"ok": True}
 
@@ -335,10 +368,22 @@ class NodeDaemon:
             info.last_heartbeat = time.time()
             info.available = dict(msg.get("available") or {})
             info.queued = int(msg.get("queued", 0))
+            # Totals change when placement-group bundles commit/release
+            # (group resources are added to the node pool).
+            total = msg.get("total")
+            if total is not None:
+                info.resources = dict(total)
         # Parked tasks (forward raced a node death, or no feasible node
-        # yet) get another placement attempt on the heartbeat tick.
+        # yet) and pending placement groups get another placement
+        # attempt on the heartbeat tick.
         with self._lock:
             any_parked = bool(self._infeasible)
+            any_pending_pg = any(
+                e.state in ("PENDING", "RESCHEDULING")
+                for e in self.pgs.values()
+            )
+        if any_pending_pg:
+            self._retry_pending_pgs()
         if any_parked:
             self._retry_infeasible()
         return {"ok": True}
@@ -350,6 +395,7 @@ class NodeDaemon:
                     "node_heartbeat",
                     node_id=self.node_id.binary(),
                     available=self.scheduler.available().to_dict(),
+                    total=self.scheduler.total().to_dict(),
                     queued=self.scheduler.queued_count(),
                 )
             except Exception:
@@ -945,12 +991,14 @@ class NodeDaemon:
             nid = info.node_id.binary()
             if nid == mine:
                 avail = self.scheduler.available()
+                total = self.scheduler.total()
             else:
                 avail = ResourceSet(info.available)
+                total = ResourceSet(info.resources)
             views.append(
                 NodeView(
                     node_id=nid,
-                    total=ResourceSet(info.resources),
+                    total=total,
                     available=avail,
                     labels=info.labels,
                     is_local=(nid == mine),
@@ -1633,6 +1681,309 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     # node death (head)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # placement groups (reference: gcs_placement_group_manager.cc on the
+    # head + placement_group_resource_manager.h 2PC on each node)
+    # ------------------------------------------------------------------
+    def _h_create_placement_group(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "create_placement_group",
+                pg_id=msg["pg_id"],
+                bundles=msg["bundles"],
+                strategy=msg["strategy"],
+                name=msg.get("name", ""),
+            )
+        strategy = msg["strategy"]
+        if strategy not in STRATEGIES:
+            return {"error": f"unknown strategy {strategy!r}"}
+        entry = PGEntry(
+            pg_id=msg["pg_id"],
+            bundles=list(msg["bundles"]),
+            strategy=strategy,
+            name=msg.get("name", ""),
+        )
+        with self._lock:
+            if entry.name:
+                for other in self.pgs.values():
+                    if other.name == entry.name and other.state != "REMOVED":
+                        return {
+                            "error": f"placement group name {entry.name!r}"
+                            " already taken"
+                        }
+            self.pgs[entry.pg_id] = entry
+        self._try_place_pg(entry)
+        return {"ok": True}
+
+    def _h_placement_group_state(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "placement_group_state", pg_id=msg["pg_id"]
+            )
+        entry = self.pgs.get(msg["pg_id"])
+        if entry is None:
+            return {"state": None}
+        return {"state": entry.state, "entry": entry.to_table_entry()}
+
+    def _h_placement_group_table(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("placement_group_table")
+        with self._lock:
+            table = [e.to_table_entry() for e in self.pgs.values()]
+        return {"table": table}
+
+    def _h_remove_placement_group(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "remove_placement_group", pg_id=msg["pg_id"]
+            )
+        with self._pg_mutex:
+            with self._lock:
+                entry = self.pgs.get(msg["pg_id"])
+                if entry is None or entry.state == "REMOVED":
+                    return {"ok": True}
+                entry.state = "REMOVED"
+                assignment = list(entry.bundle_nodes)
+                entry.bundle_nodes = [None] * len(entry.bundles)
+            for index, node in enumerate(assignment):
+                if node is not None:
+                    self._bundle_call(
+                        node,
+                        "release_bundle",
+                        pg_id=entry.pg_id,
+                        bundle_index=index,
+                    )
+        self._purge_pg_tasks(entry.pg_id.hex())
+        self._schedule()
+        return {"ok": True}
+
+    def _purge_pg_tasks(self, pg_hex: str) -> None:
+        """Fail tasks parked on a removed group's resources — their
+        formatted resources can never exist again."""
+        with self._lock:
+            doomed = [
+                (tid, spec)
+                for tid, spec in self._infeasible.items()
+                if any(
+                    pg_hex in name
+                    for name in (spec.get("resources") or {})
+                )
+            ]
+            for tid, _ in doomed:
+                del self._infeasible[tid]
+        for _, spec in doomed:
+            self._fail_task_returns(
+                spec,
+                "TaskError",
+                f"placement group {pg_hex} was removed",
+            )
+
+    def _try_place_pg(self, entry: PGEntry) -> None:
+        """Attempt bundle placement + 2PC; leaves the group PENDING /
+        RESCHEDULING when infeasible (retried on cluster change). The
+        group mutex serializes against concurrent retries and removal."""
+        with self._pg_mutex:
+            created = self._try_place_pg_locked(entry)
+        if created:
+            # Group resources now exist: tasks gated on them can place.
+            self._retry_infeasible()
+            self._schedule()
+
+    def _try_place_pg_locked(self, entry: PGEntry) -> bool:
+        with self._lock:
+            if entry.state in ("REMOVED", "CREATED"):
+                return False
+            missing = [
+                i for i, n in enumerate(entry.bundle_nodes) if n is None
+            ]
+            exclude = []
+            if entry.strategy == "STRICT_SPREAD":
+                exclude = [n for n in entry.bundle_nodes if n is not None]
+        if not missing:
+            with self._lock:
+                entry.state = "CREATED"
+            return True
+        assignment = place_bundles(
+            [entry.bundles[i] for i in missing],
+            entry.strategy if entry.strategy != "STRICT_PACK" or len(
+                missing
+            ) == len(entry.bundles) else "PACK",
+            self._node_views(),
+            exclude=exclude,
+        )
+        if assignment is None:
+            return False
+        prepared = []
+        ok = True
+        for offset, index in enumerate(missing):
+            node = assignment[offset]
+            reply = self._bundle_call(
+                node,
+                "prepare_bundle",
+                pg_id=entry.pg_id,
+                bundle_index=index,
+                resources=entry.bundles[index],
+            )
+            if not reply.get("ok"):
+                ok = False
+                break
+            prepared.append((index, node))
+        if not ok:
+            for index, node in prepared:
+                self._bundle_call(
+                    node,
+                    "release_bundle",
+                    pg_id=entry.pg_id,
+                    bundle_index=index,
+                )
+            return False
+        for index, node in prepared:
+            self._bundle_call(
+                node,
+                "commit_bundle",
+                pg_id=entry.pg_id,
+                bundle_index=index,
+            )
+            with self._lock:
+                entry.bundle_nodes[index] = node
+        with self._lock:
+            if all(n is not None for n in entry.bundle_nodes):
+                entry.state = "CREATED"
+        return True
+
+    def _retry_pending_pgs(self) -> None:
+        with self._lock:
+            pending = [
+                e
+                for e in self.pgs.values()
+                if e.state in ("PENDING", "RESCHEDULING")
+            ]
+        for entry in pending:
+            self._try_place_pg(entry)
+
+    def _maybe_retry_pgs(self) -> None:
+        """Capacity just freed somewhere: give pending groups another
+        shot. Runs from _schedule(), so a non-blocking gate breaks the
+        place -> commit -> _schedule recursion (and makes concurrent
+        callers coalesce instead of queueing)."""
+        with self._lock:
+            pending = any(
+                e.state in ("PENDING", "RESCHEDULING")
+                for e in self.pgs.values()
+            )
+        if not pending:
+            return
+        if not self._pg_retry_gate.acquire(blocking=False):
+            return
+        try:
+            self._retry_pending_pgs()
+        finally:
+            self._pg_retry_gate.release()
+
+    def _bundle_call(self, node_id: bytes, method: str, **kwargs) -> dict:
+        """Run a bundle 2PC verb locally or on a remote node."""
+        if node_id == self.node_id.binary():
+            handler = getattr(self, "_h_" + method)
+            return handler(None, kwargs)
+        client = self._node_client(node_id)
+        if client is None:
+            return {"ok": False}
+        try:
+            return client.call(method, **kwargs)
+        except RpcError:
+            return {"ok": False}
+
+    def _h_prepare_bundle(self, conn, msg):
+        request = ResourceSet(msg["resources"])
+        if not self.scheduler.try_reserve(request):
+            return {"ok": False}
+        with self._lock:
+            self._bundles[(msg["pg_id"], msg["bundle_index"])] = {
+                "resources": dict(msg["resources"]),
+                "committed": False,
+            }
+        return {"ok": True}
+
+    def _h_commit_bundle(self, conn, msg):
+        key = (msg["pg_id"], msg["bundle_index"])
+        with self._lock:
+            bundle = self._bundles.get(key)
+            if bundle is None:
+                return {"ok": False}
+            bundle["committed"] = True
+        formatted = group_resources(
+            msg["pg_id"].hex(), msg["bundle_index"], bundle["resources"]
+        )
+        self.scheduler.add_capacity(ResourceSet(formatted))
+        # Local 2PC calls (conn is None) run with _pg_mutex held; the
+        # placing caller triggers scheduling after release.
+        if conn is not None:
+            self._schedule()
+        return {"ok": True}
+
+    def _h_release_bundle(self, conn, msg):
+        key = (msg["pg_id"], msg["bundle_index"])
+        with self._lock:
+            bundle = self._bundles.pop(key, None)
+        if bundle is None:
+            return {"ok": True}
+        if bundle["committed"]:
+            # Formatted capacity exists only after commit; a rolled-back
+            # prepare must not subtract it.
+            formatted = group_resources(
+                msg["pg_id"].hex(), msg["bundle_index"], bundle["resources"]
+            )
+            self.scheduler.remove_capacity(ResourceSet(formatted))
+        self.scheduler.add_capacity(ResourceSet(bundle["resources"]))
+        # Tasks queued on this node against the group's formatted
+        # resources can never run again — fail them now instead of
+        # letting the caller's get() hang.
+        pg_hex = msg["pg_id"].hex()
+        doomed = self.scheduler.drain_queued(
+            lambda spec: any(
+                pg_hex in name for name in (spec.get("resources") or {})
+            )
+        )
+        for spec in doomed:
+            self._fail_task_returns(
+                spec, "TaskError", f"placement group {pg_hex} was removed"
+            )
+            if not self.is_head:
+                try:
+                    self.head.notify(
+                        "task_finished",
+                        task_id=spec["task_id"],
+                        had_error=True,
+                    )
+                except Exception:
+                    pass
+        if conn is not None:
+            self._schedule()
+        return {"ok": True}
+
+    def _pg_on_node_death(self, node_id: bytes) -> None:
+        """Bundles on a dead node are lost; re-place them elsewhere
+        (reference: GcsPlacementGroupManager::OnNodeDead reschedules
+        lost bundles)."""
+        affected = []
+        with self._lock:
+            for entry in self.pgs.values():
+                if entry.state == "REMOVED":
+                    continue
+                lost = False
+                for i, n in enumerate(entry.bundle_nodes):
+                    if n == node_id:
+                        entry.bundle_nodes[i] = None
+                        lost = True
+                if lost:
+                    if entry.strategy == "STRICT_PACK":
+                        # Bundles are co-located: all died together.
+                        entry.bundle_nodes = [None] * len(entry.bundles)
+                    entry.state = "RESCHEDULING"
+                    affected.append(entry)
+        for entry in affected:
+            self._try_place_pg(entry)
+
     def _on_node_death(self, node_id: bytes) -> None:
         """Handle a worker node's death: drop locations, retry its
         tasks, restart its actors (reference: GcsNodeManager death
@@ -1641,6 +1992,7 @@ class NodeDaemon:
         if self._shutdown:
             return
         self.control.mark_node_dead(NodeID(node_id))
+        self._pg_on_node_death(node_id)
         with self._lock:
             client = self._node_clients.pop(node_id, None)
         if client is not None:
@@ -1747,6 +2099,8 @@ class NodeDaemon:
         if self._shutdown:
             return
         self.scheduler.maybe_dispatch(self._deps_ready, self._try_dispatch)
+        if self.is_head:
+            self._maybe_retry_pgs()
 
     def _deps_ready(self, spec: dict) -> bool:
         missing = []

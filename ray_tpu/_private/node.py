@@ -11,27 +11,16 @@ connects to a daemon started by `rt start --head` (cli.py).
 from __future__ import annotations
 
 import atexit
-import glob
 import os
 import tempfile
 import time
 from typing import Dict, Optional
 
+from .accelerators import detect_accelerators
 from .config import Config
 from .daemon import NodeDaemon
 from .rpc import configure_chaos
 from .worker import CoreWorker, set_global_worker
-
-
-def detect_num_tpu_chips() -> int:
-    """TPU chip count via device files (reference:
-    python/ray/_private/accelerators/tpu.py:107 — counts /dev/accel*)."""
-    chips = len(glob.glob("/dev/accel*"))
-    if chips:
-        return chips
-    if glob.glob("/dev/vfio/*"):
-        return len([p for p in glob.glob("/dev/vfio/*") if p.split("/")[-1].isdigit()])
-    return 0
 
 
 class Session:
@@ -56,16 +45,19 @@ class Session:
             total.setdefault(
                 "CPU", float(num_cpus if num_cpus is not None else os.cpu_count())
             )
-            tpus = (
-                float(num_tpus)
-                if num_tpus is not None
-                else float(detect_num_tpu_chips())
+            detected, labels = detect_accelerators(
+                {"TPU": float(num_tpus)} if num_tpus is not None else None
             )
-            if tpus:
-                total.setdefault("TPU", tpus)
+            for name, amount in detected.items():
+                if amount:
+                    total.setdefault(name, amount)
             total.setdefault("memory", float(2**34))
             self.daemon = NodeDaemon(
-                self.session_dir, total, self.config, is_head=True
+                self.session_dir,
+                total,
+                self.config,
+                is_head=True,
+                labels=labels,
             )
             self.daemon.start()
             address = self.daemon.socket_path

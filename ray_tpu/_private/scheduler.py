@@ -106,6 +106,18 @@ class LocalScheduler:
             self._total = self._total.subtract(extra)
             self._available = self._available.subtract(extra)
 
+    def try_reserve(self, request: ResourceSet) -> bool:
+        """Atomically carve `request` out of this node's pool (both
+        total and available) — the placement-group bundle prepare step
+        (reference: raylet/placement_group_resource_manager.h 2PC).
+        Fails if the resources are not currently free."""
+        with self._lock:
+            if not request.fits_in(self._available):
+                return False
+            self._total = self._total.subtract(request)
+            self._available = self._available.subtract(request)
+            return True
+
     # ---- queueing ----
     def enqueue(self, task_id, request: ResourceSet, spec) -> None:
         with self._lock:
@@ -114,6 +126,19 @@ class LocalScheduler:
     def cancel(self, task_id) -> bool:
         with self._lock:
             return self._queue.pop(task_id, None) is not None
+
+    def drain_queued(self, predicate) -> list:
+        """Remove and return the specs of queued tasks matching
+        `predicate(spec)` (used to fail tasks stranded by a removed
+        placement group's resources)."""
+        drained = []
+        with self._lock:
+            for task_id in list(self._queue):
+                _, spec = self._queue[task_id]
+                if predicate(spec):
+                    del self._queue[task_id]
+                    drained.append(spec)
+        return drained
 
     def queued_count(self) -> int:
         with self._lock:

@@ -76,6 +76,10 @@ class _TaskContext(threading.local):
         self.thread_base_id: TaskID = TaskID.from_random()
         self.put_index = 0
         self.submit_index = 0
+        # Placement-group capture context of the currently executing
+        # task: child submits inherit it (reference: actor.py:890
+        # placement_group_capture_child_tasks).
+        self.pg_context: Optional[dict] = None
 
 
 _worker_generation = itertools.count()
@@ -93,6 +97,7 @@ class CoreWorker:
         self._task_queue: "queue.Queue[dict]" = queue.Queue()
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
+        self._actor_pg_context: Optional[dict] = None
         self._running = True
         self._client = RpcClient(socket_path, push_handler=self._on_push)
         reply = self._client.call(
@@ -273,6 +278,7 @@ class CoreWorker:
         resources: Optional[Dict[str, float]] = None,
         max_retries: int = 0,
         scheduling_strategy: Optional[dict] = None,
+        pg_context: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
         returns = [
@@ -289,6 +295,7 @@ class CoreWorker:
             "resources": resources or {"CPU": 1.0},
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
+            "pg_context": pg_context,
         }
         self._client.call("submit_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
@@ -304,6 +311,7 @@ class CoreWorker:
         max_restarts: int = 0,
         handle_meta: Optional[dict] = None,
         scheduling_strategy: Optional[dict] = None,
+        pg_context: Optional[dict] = None,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
@@ -322,6 +330,7 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "handle_meta": handle_meta,
             "scheduling_strategy": scheduling_strategy,
+            "pg_context": pg_context,
         }
         self._client.call("create_actor", spec=spec)
         return actor_id
@@ -373,6 +382,11 @@ class CoreWorker:
             self._running = False
             self._task_queue.put(None)
 
+    def current_pg_context(self) -> Optional[dict]:
+        """Capturing-placement-group context of the task this thread is
+        executing, if any."""
+        return getattr(self._ctx, "pg_context", None)
+
     def run_task_loop(self) -> None:
         """Blocking execution loop (reference:
         CoreWorkerProcess::RunTaskExecutionLoop)."""
@@ -387,6 +401,11 @@ class CoreWorker:
         self._ctx.task_id = task_id
         self._ctx.put_index = 0
         self._ctx.submit_index = 0
+        # Actor methods inherit the capture context the actor was
+        # created with (the creation spec carried it).
+        self._ctx.pg_context = spec.get("pg_context") or (
+            self._actor_pg_context if spec["kind"] == "actor_task" else None
+        )
         self.job_id = JobID(spec["job_id"])
         try:
             args, kwargs = _split_kwargs(self._deserialize_args(spec["args"]))
@@ -395,6 +414,7 @@ class CoreWorker:
                 cls = self.functions.fetch(spec["function_key"])
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_id = ActorID(spec["actor_id"])
+                self._actor_pg_context = spec.get("pg_context")
                 results = [None]
             elif kind == "actor_task":
                 if self._actor_instance is None:
@@ -417,6 +437,7 @@ class CoreWorker:
             return
         finally:
             self._ctx.task_id = None
+            self._ctx.pg_context = None
         try:
             for oid_bytes, value in zip(spec["returns"], results):
                 self.put_object(ObjectID(oid_bytes), value)

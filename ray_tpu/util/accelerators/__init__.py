@@ -1,0 +1,5 @@
+"""Accelerator helper APIs (reference: python/ray/util/accelerators/)."""
+
+from . import tpu
+
+__all__ = ["tpu"]

@@ -10,7 +10,7 @@ is added with placement groups.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Any, Dict, List
 
 
 @dataclass
@@ -39,6 +39,25 @@ class NodeLabelSchedulingStrategy:
 
     def to_spec(self) -> dict:
         return {"type": "NODE_LABEL", "hard": self.hard, "soft": self.soft}
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Schedule a task/actor into a placement group's reserved bundle
+    resources. `placement_group_bundle_index=-1` targets any bundle
+    (the group's wildcard resources)."""
+
+    placement_group: Any
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    def to_spec(self) -> dict:
+        return {
+            "type": "PLACEMENT_GROUP",
+            "pg_id": self.placement_group.id,
+            "bundle_index": self.placement_group_bundle_index,
+            "capture": self.placement_group_capture_child_tasks,
+        }
 
 
 def strategy_to_spec(strategy) -> dict | None:

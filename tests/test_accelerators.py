@@ -1,0 +1,102 @@
+"""TPU accelerator-manager tests (reference test model:
+python/ray/tests/accelerators/test_tpu.py — detection overrides, pod
+metadata resources, slice gang reservation)."""
+
+import pytest
+
+
+def test_pod_type_parsing():
+    from ray_tpu._private.accelerators.tpu import (
+        TPUAcceleratorManager,
+        chips_per_host,
+        pod_type_num_chips,
+        pod_worker_count,
+    )
+
+    assert pod_type_num_chips("v5e-16") == 16
+    assert pod_type_num_chips("v4-8") == 8
+    assert pod_type_num_chips("v3-32") == 16  # v2/v3 count cores
+    assert chips_per_host("v5e-16") == 4
+    assert chips_per_host("v5e-1") == 1
+    assert pod_worker_count("v5e-16") == 4
+    assert pod_worker_count("v5e-4") == 1
+    assert TPUAcceleratorManager.is_valid_tpu_accelerator_type("v5e-16")
+    assert not TPUAcceleratorManager.is_valid_tpu_accelerator_type("tpu-16")
+    with pytest.raises(ValueError):
+        pod_type_num_chips("nope")
+
+
+def test_detection_env_override(monkeypatch):
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+    monkeypatch.setenv("RT_TPU_CHIPS", "4")
+    TPUAcceleratorManager.get_current_node_num_accelerators.cache_clear()
+    assert TPUAcceleratorManager.get_current_node_num_accelerators() == 4
+    TPUAcceleratorManager.get_current_node_num_accelerators.cache_clear()
+
+
+def test_pod_resources_and_labels(monkeypatch):
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+    monkeypatch.setenv("RT_TPU_POD_TYPE", "v5e-16")
+    monkeypatch.setenv("RT_TPU_NAME", "my-slice")
+    monkeypatch.setenv("RT_TPU_WORKER_ID", "0")
+    resources, labels = (
+        TPUAcceleratorManager.get_extra_resources_and_labels(4)
+    )
+    assert resources["TPU-v5e-16-head"] == 1.0
+    assert resources["my-slice"] == 1.0
+    assert labels["rt.io/tpu-pod-type"] == "v5e-16"
+    assert labels["rt.io/tpu-worker-id"] == "0"
+
+    # Non-zero workers don't claim the head marker.
+    monkeypatch.setenv("RT_TPU_WORKER_ID", "2")
+    resources, _ = TPUAcceleratorManager.get_extra_resources_and_labels(4)
+    assert "TPU-v5e-16-head" not in resources
+    assert resources["my-slice"] == 1.0
+
+
+def test_slice_gang_reservation():
+    """A fake 4-host v5e-16 slice is gang-reserved by a STRICT_SPREAD
+    placement group over its per-host pod-name resources."""
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import PlacementGroupSchedulingStrategy
+    from ray_tpu.util.accelerators.tpu import slice_placement_group
+
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    try:
+        for _ in range(4):
+            cluster.add_node(
+                num_cpus=1,
+                resources={"TPU": 4.0, "my-slice": 1.0},
+                labels={"rt.io/tpu-pod-name": "my-slice"},
+            )
+        rt.init(address=cluster.address)
+        pg = slice_placement_group("v5e-16", pod_name="my-slice")
+        assert pg.bundle_count == 4
+        assert pg.wait(15)
+
+        @rt.remote(num_cpus=0)
+        def host_id():
+            import os
+
+            return os.environ.get("RT_SOCKET", "")
+
+        sockets = rt.get(
+            [
+                host_id.options(
+                    resources={"TPU": 1.0},
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=i,
+                    ),
+                ).remote()
+                for i in range(4)
+            ],
+            timeout=60,
+        )
+        assert len(set(sockets)) == 4
+    finally:
+        rt.shutdown()
+        cluster.shutdown()

@@ -1,0 +1,302 @@
+"""Placement-group tests (reference test model:
+python/ray/tests/test_placement_group*.py — creation/ready, strategy
+semantics across nodes, bundle-index targeting, removal releasing
+resources, rescheduling on node death)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def rt_cluster(cluster):
+    import ray_tpu as rt
+
+    rt.init(address=cluster.address)
+    yield rt, cluster
+    rt.shutdown()
+
+
+def test_create_wait_ready_and_schedule(rt_session):
+    rt = rt_session
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1.0}, {"CPU": 1.0}], strategy="PACK")
+    assert pg.wait(10)
+    assert rt.get(pg.ready(), timeout=10) is True
+
+    @rt.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg
+        ),
+    )
+    def inside():
+        return "ok"
+
+    assert rt.get(inside.remote(), timeout=10) == "ok"
+
+
+def test_pg_pending_until_feasible(rt_cluster):
+    rt, cluster = rt_cluster
+    from ray_tpu.util import placement_group
+
+    # Head has 2 CPU; a 4-CPU bundle can't exist yet.
+    pg = placement_group([{"CPU": 4.0}], strategy="PACK")
+    assert not pg.wait(0.5)
+    assert pg.state() == "PENDING"
+    cluster.add_node(num_cpus=4)
+    assert pg.wait(10)
+
+
+def test_strict_spread_lands_on_distinct_nodes(rt_cluster):
+    rt, cluster = rt_cluster
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        placement_group_table,
+    )
+
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(3)
+    pg = placement_group(
+        [{"CPU": 1.0}, {"CPU": 1.0}, {"CPU": 1.0}], strategy="STRICT_SPREAD"
+    )
+    assert pg.wait(10)
+    [entry] = [
+        e
+        for e in placement_group_table()
+        if e["placement_group_id"] == pg.id
+    ]
+    assert entry["state"] == "CREATED"
+    assert len(set(entry["bundle_nodes"])) == 3
+
+    # Bundle-index targeting pins tasks to the bundle's node.
+    @rt.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ.get("RT_SOCKET", "")
+
+    sockets = set()
+    for index in range(3):
+        strat = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=index
+        )
+        sockets.add(
+            rt.get(where.options(scheduling_strategy=strat).remote(),
+                   timeout=30)
+        )
+    assert len(sockets) == 3
+
+
+def test_strict_pack_on_one_node(rt_cluster):
+    rt, cluster = rt_cluster
+    from ray_tpu.util import placement_group, placement_group_table
+
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+    pg = placement_group(
+        [{"CPU": 2.0}, {"CPU": 2.0}], strategy="STRICT_PACK"
+    )
+    assert pg.wait(10)
+    [entry] = [
+        e
+        for e in placement_group_table()
+        if e["placement_group_id"] == pg.id
+    ]
+    assert len(set(entry["bundle_nodes"])) == 1
+
+
+def test_remove_releases_resources(rt_session):
+    rt = rt_session
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    before = rt.available_resources().get("CPU", 0.0)
+    pg = placement_group([{"CPU": 2.0}], strategy="PACK")
+    assert pg.wait(10)
+    during = rt.available_resources().get("CPU", 0.0)
+    assert during == pytest.approx(before - 2.0)
+    remove_placement_group(pg)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if rt.available_resources().get("CPU", 0.0) == pytest.approx(before):
+            break
+        time.sleep(0.05)
+    assert rt.available_resources().get("CPU", 0.0) == pytest.approx(before)
+
+
+def test_actor_in_placement_group(rt_session):
+    rt = rt_session
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1.0}], strategy="PACK")
+    assert pg.wait(10)
+
+    @rt.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    )
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert rt.get(c.bump.remote(), timeout=15) == 1
+
+
+def test_pg_rescheduled_after_node_death(rt_cluster):
+    rt, cluster = rt_cluster
+    from ray_tpu.util import placement_group, placement_group_table
+
+    victim = cluster.add_node(num_cpus=4, resources={"big": 4.0})
+    cluster.wait_for_nodes(2)
+    # Bundle only fits on the worker node (head has 2 CPU).
+    pg = placement_group([{"CPU": 3.0}], strategy="PACK")
+    assert pg.wait(10)
+    cluster.remove_node(victim)
+    # Group goes to RESCHEDULING; a replacement node revives it.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        [entry] = [
+            e
+            for e in placement_group_table()
+            if e["placement_group_id"] == pg.id
+        ]
+        if entry["state"] == "RESCHEDULING":
+            break
+        time.sleep(0.05)
+    assert entry["state"] == "RESCHEDULING"
+    cluster.add_node(num_cpus=4)
+    assert pg.wait(15)
+
+
+def test_capture_child_tasks(rt_session):
+    """Children of a capturing task inherit the group (reference:
+    placement_group_capture_child_tasks)."""
+    rt = rt_session
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+    )
+
+    pg = placement_group([{"CPU": 2.0}], strategy="PACK")
+    assert pg.wait(10)
+
+    @rt.remote(num_cpus=1)
+    def child():
+        return "child-done"
+
+    @rt.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_capture_child_tasks=True,
+        ),
+    )
+    def parent():
+        import ray_tpu as rt_inner
+
+        ref = child.remote()
+        return rt_inner.get(ref, timeout=20)
+
+    assert rt.get(parent.remote(), timeout=30) == "child-done"
+    # The child consumed group resources: with capture, both parent and
+    # child fit only because the bundle has 2 CPUs.
+
+
+def test_head_only_pending_pg_retries_on_capacity_free(rt_session):
+    """A PENDING group on a single-node cluster is retried when running
+    tasks release their resources (no heartbeat traffic exists)."""
+    rt = rt_session
+    import threading
+
+    from ray_tpu.util import placement_group
+
+    release = threading.Event()
+
+    @rt.remote(num_cpus=3)
+    def hog():
+        import time as _t
+
+        _t.sleep(1.0)
+        return "done"
+
+    ref = hog.remote()
+    import time as _t
+
+    _t.sleep(0.3)  # hog is running, 1 of 4 CPUs free
+    pg = placement_group([{"CPU": 3.0}], strategy="PACK")
+    assert pg.state() == "PENDING"
+    assert rt.get(ref, timeout=20) == "done"
+    assert pg.wait(10)
+
+
+def test_remove_pg_fails_queued_tasks(rt_session):
+    """Tasks queued on a removed group's resources fail instead of
+    hanging."""
+    rt = rt_session
+    import ray_tpu.exceptions as exc
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1.0}], strategy="PACK")
+    assert pg.wait(10)
+
+    @rt.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg
+        ),
+    )
+    def slow():
+        import time as _t
+
+        _t.sleep(3.0)
+        return "first"
+
+    first = slow.remote()
+    second = slow.remote()  # queued behind first in the 1-CPU bundle
+    import time as _t
+
+    _t.sleep(0.5)
+    remove_placement_group(pg)
+    with pytest.raises(Exception):
+        rt.get(second, timeout=10)
+
+
+def test_named_pg_lookup_and_duplicate_rejection(rt_session):
+    rt = rt_session
+    from ray_tpu.util import get_placement_group, placement_group
+
+    pg = placement_group([{"CPU": 1.0}], strategy="PACK", name="gang")
+    assert pg.wait(10)
+    found = get_placement_group("gang")
+    assert found.id == pg.id
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1.0}], name="gang")
