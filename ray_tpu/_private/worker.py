@@ -419,8 +419,17 @@ class CoreWorker:
             elif kind == "actor_task":
                 if self._actor_instance is None:
                     raise exc.ActorDiedError("actor instance missing")
-                method = getattr(self._actor_instance, spec["method"])
-                value = method(*args, **kwargs)
+                if spec["method"] == "__rt_dag_loop__":
+                    # Compiled-DAG execution loop: the actor blocks on
+                    # its channels until torn down (dag/compiled.py).
+                    from ..dag.compiled import dag_exec_loop
+
+                    value = dag_exec_loop(
+                        self._actor_instance, *args, **kwargs
+                    )
+                else:
+                    method = getattr(self._actor_instance, spec["method"])
+                    value = method(*args, **kwargs)
                 results = self._split_returns(value, len(spec["returns"]))
             else:
                 func = self.functions.fetch(spec["function_key"])

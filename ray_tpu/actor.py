@@ -68,6 +68,13 @@ class ActorMethod:
             self._handle, self._name, args, kwargs, self._num_returns
         )
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this method call (reference:
+        python/ray/dag/class_node.py)."""
+        from .dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
 
 class ActorHandle:
     """Serializable reference to a live actor."""

@@ -1,0 +1,24 @@
+"""Lazy + compiled DAG API (reference: python/ray/dag/)."""
+
+from .channels import ShmChannel
+from .compiled import CompiledDAG, CompiledDAGRef
+from .dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+    experimental_compile,
+)
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "MultiOutputNode",
+    "FunctionNode",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "ShmChannel",
+    "experimental_compile",
+]

@@ -1,0 +1,157 @@
+"""Shared-memory SPSC channels for compiled DAGs.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py:159
+— compiled graphs move data over mutable plasma buffers with
+acquire/release semantics (core_worker/experimental_mutable_object_
+manager.h:48) instead of per-call RPC. Here a channel is a POSIX
+shared-memory ring buffer: single writer, single reader, length-framed
+pickled records, monotonic head/tail counters in the segment header.
+Same-host only by design — cross-host stage boundaries in a TPU
+pipeline ride ICI/DCN collectives inside the jitted program
+(parallel/pipeline), not the control-plane channel.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Optional
+
+_HEADER = 16  # two u64 counters: head (written), tail (read)
+_LEN = 8  # per-record length prefix
+
+STOP = b"__RT_DAG_STOP__"
+
+
+class ChannelClosedError(Exception):
+    pass
+
+
+class ChannelTimeoutError(Exception):
+    pass
+
+
+class ShmChannel:
+    """Single-producer single-consumer shared-memory ring buffer."""
+
+    def __init__(
+        self,
+        capacity: int = 4 * 1024 * 1024,
+        *,
+        name: Optional[str] = None,
+        create: bool = True,
+    ):
+        self.capacity = capacity
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=_HEADER + capacity
+            )
+            self._shm.buf[:_HEADER] = b"\x00" * _HEADER
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            # The creator owns the segment lifetime; stop the attaching
+            # process's resource tracker from unlinking it at exit.
+            try:
+                resource_tracker.unregister(
+                    self._shm._name, "shared_memory"  # noqa: SLF001
+                )
+            except Exception:
+                pass
+        self.name = self._shm.name
+        self._closed = False
+
+    # -- counters ------------------------------------------------------
+    def _head(self) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, 0)[0]
+
+    def _tail(self) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, 8)[0]
+
+    def _set_head(self, v: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, 0, v)
+
+    def _set_tail(self, v: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, 8, v)
+
+    # -- ring IO -------------------------------------------------------
+    def _write_at(self, pos: int, payload: bytes) -> None:
+        offset = pos % self.capacity
+        first = min(len(payload), self.capacity - offset)
+        base = _HEADER + offset
+        self._shm.buf[base : base + first] = payload[:first]
+        if first < len(payload):
+            rest = len(payload) - first
+            self._shm.buf[_HEADER : _HEADER + rest] = payload[first:]
+
+    def _read_at(self, pos: int, size: int) -> bytes:
+        offset = pos % self.capacity
+        first = min(size, self.capacity - offset)
+        base = _HEADER + offset
+        out = bytes(self._shm.buf[base : base + first])
+        if first < size:
+            out += bytes(self._shm.buf[_HEADER : _HEADER + size - first])
+        return out
+
+    # -- public --------------------------------------------------------
+    def put_bytes(self, payload: bytes, timeout: Optional[float] = None):
+        record = len(payload) + _LEN
+        if record > self.capacity:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds channel "
+                f"capacity {self.capacity}; recompile with a larger "
+                "buffer_size_bytes"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.capacity - (self._head() - self._tail()) < record:
+            if self._closed:
+                raise ChannelClosedError(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"put on {self.name}")
+            time.sleep(0.0002)
+        head = self._head()
+        self._write_at(head, struct.pack("<Q", len(payload)))
+        self._write_at(head + _LEN, payload)
+        self._set_head(head + record)
+
+    def get_bytes(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._head() - self._tail() < _LEN:
+            if self._closed:
+                raise ChannelClosedError(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"get on {self.name}")
+            time.sleep(0.0002)
+        tail = self._tail()
+        (size,) = struct.unpack("<Q", self._read_at(tail, _LEN))
+        payload = self._read_at(tail + _LEN, size)
+        self._set_tail(tail + _LEN + size)
+        return payload
+
+    def put(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.put_bytes(pickle.dumps(value), timeout=timeout)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return pickle.loads(self.get_bytes(timeout=timeout))
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._shm.close()
+        except BufferError:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __reduce__(self):
+        # Deserializing attaches to the same segment (reader side).
+        return (_attach, (self.name, self.capacity))
+
+
+def _attach(name: str, capacity: int) -> "ShmChannel":
+    return ShmChannel(capacity, name=name, create=False)

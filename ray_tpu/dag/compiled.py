@@ -1,0 +1,279 @@
+"""Compiled DAG execution.
+
+Reference: python/ray/dag/compiled_dag_node.py:691 — compiling an
+actor DAG replaces per-call task RPC with persistent per-actor
+execution loops connected by channels: each actor blocks on its input
+channel(s), runs its bound method, and pushes the result downstream.
+One `execute()` then costs channel writes instead of scheduler
+round-trips, which is what pipelines (micro-batched inference/training
+stages) need.
+
+Protocol records on every channel: ("v", value) | ("e", exception) |
+("s", None) for stop. Errors and stop tokens propagate downstream so
+one teardown() at the driver drains the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..actor import ActorMethod
+from .channels import ShmChannel
+from .dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+DAG_LOOP_METHOD = "__rt_dag_loop__"
+
+
+def dag_exec_loop(
+    instance: Any,
+    method_name: str,
+    arg_descs: List[Tuple[str, Any]],
+    out_channels: List[ShmChannel],
+):
+    """Runs inside the actor (worker._execute special-cases the
+    method name): block on inputs, apply, push downstream."""
+    try:
+        while True:
+            args = []
+            stop = False
+            error = None
+            for kind, value in arg_descs:
+                if kind == "const":
+                    args.append(value)
+                    continue
+                tag, payload = value.get()
+                if tag == "s":
+                    stop = True
+                elif tag == "e":
+                    error = payload
+                else:
+                    args.append(payload)
+            if stop:
+                for chan in out_channels:
+                    chan.put(("s", None))
+                return "stopped"
+            if error is not None:
+                for chan in out_channels:
+                    chan.put(("e", error))
+                continue
+            try:
+                result = getattr(instance, method_name)(*args)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                for chan in out_channels:
+                    chan.put(("e", e))
+                continue
+            for chan in out_channels:
+                chan.put(("v", result))
+    finally:
+        for _, value in arg_descs:
+            if not isinstance(value, ShmChannel):
+                continue
+            value.close()
+        for chan in out_channels:
+            chan.close()
+
+
+class CompiledDAGRef:
+    """Future for one execute() (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value: Any = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = 30.0):
+        if not self._done:
+            self._value = self._dag._read_result(self._seq, timeout)
+            self._done = True
+        if isinstance(self._value, BaseException):
+            raise self._value
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 4 * 2**20):
+        self._root = root
+        self._buffer = buffer_size_bytes
+        self._lock = threading.Lock()
+        self._read_mutex = threading.Lock()
+        self._next_seq = 0
+        self._next_read_seq = 0
+        self._results: Dict[int, Any] = {}
+        self._torn_down = False
+        self._input_channels: List[ShmChannel] = []
+        self._output_channels: List[ShmChannel] = []
+        self._all_channels: List[ShmChannel] = []
+        self._loop_refs = []
+        self._compile()
+
+    # -- compilation ---------------------------------------------------
+    def _compile(self) -> None:
+        order = self._root.topological_order()
+        inputs = [n for n in order if isinstance(n, InputNode)]
+        if len(inputs) != 1:
+            raise ValueError(
+                "compiled DAGs need exactly one InputNode "
+                f"(found {len(inputs)})"
+            )
+        outputs: List[DAGNode]
+        if isinstance(self._root, MultiOutputNode):
+            outputs = list(self._root._bound_args)
+        else:
+            outputs = [self._root]
+        actor_nodes: List[ClassMethodNode] = []
+        seen_actors = set()
+        for node in order:
+            if isinstance(node, (InputNode, MultiOutputNode)):
+                continue
+            if not isinstance(node, ClassMethodNode):
+                raise TypeError(
+                    "compiled DAGs support actor-method nodes only; "
+                    f"got {type(node).__name__} (use execute() for "
+                    "interpreted task DAGs)"
+                )
+            key = node.actor_handle.actor_id.binary()
+            if key in seen_actors:
+                raise ValueError(
+                    "an actor may appear in at most one compiled-DAG "
+                    "node (its execution loop owns the actor)"
+                )
+            seen_actors.add(key)
+            actor_nodes.append(node)
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise TypeError("DAG outputs must be actor-method nodes")
+
+        # One SPSC channel per (producer -> consumer) edge.
+        in_descs: Dict[int, List[Tuple[str, Any]]] = {}
+        out_chans: Dict[int, List[ShmChannel]] = {
+            id(n): [] for n in actor_nodes
+        }
+        for node in actor_nodes:
+            descs: List[Tuple[str, Any]] = []
+            for arg in node._bound_args:
+                if isinstance(arg, InputNode):
+                    chan = self._new_channel()
+                    self._input_channels.append(chan)
+                    descs.append(("chan", chan))
+                elif isinstance(arg, ClassMethodNode):
+                    chan = self._new_channel()
+                    out_chans[id(arg)].append(chan)
+                    descs.append(("chan", chan))
+                elif isinstance(arg, DAGNode):
+                    raise TypeError(
+                        f"unsupported arg node {type(arg).__name__}"
+                    )
+                else:
+                    descs.append(("const", arg))
+            if node._bound_kwargs:
+                raise TypeError(
+                    "compiled DAGs do not support kwargs in bind()"
+                )
+            in_descs[id(node)] = descs
+        for out in outputs:
+            chan = self._new_channel()
+            self._output_channels.append(chan)
+            out_chans[id(out)].append(chan)
+
+        # Start one persistent loop per actor.
+        for node in actor_nodes:
+            method = ActorMethod(node.actor_handle, DAG_LOOP_METHOD)
+            ref = method.remote(
+                node.method_name,
+                in_descs[id(node)],
+                out_chans[id(node)],
+            )
+            self._loop_refs.append(ref)
+
+    def _new_channel(self) -> ShmChannel:
+        chan = ShmChannel(self._buffer)
+        self._all_channels.append(chan)
+        return chan
+
+    # -- execution -----------------------------------------------------
+    def execute(self, value: Any) -> CompiledDAGRef:
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG was torn down")
+            seq = self._next_seq
+            self._next_seq += 1
+            for chan in self._input_channels:
+                chan.put(("v", value))
+        return CompiledDAGRef(self, seq)
+
+    def _read_result(self, seq: int, timeout: Optional[float]):
+        """Channel records arrive in submission order. A future whose
+        turn hasn't come reads (and caches) results for the earlier
+        sequences until it reaches its own."""
+        while True:
+            with self._lock:
+                if seq in self._results:
+                    return self._results.pop(seq)
+            with self._read_mutex:
+                with self._lock:
+                    if seq in self._results:
+                        return self._results.pop(seq)
+                    current = self._next_read_seq
+                    if current > seq:
+                        raise RuntimeError(
+                            f"result {seq} was already consumed"
+                        )
+                    self._next_read_seq = current + 1
+                result = self._read_channels_once(timeout)
+                with self._lock:
+                    if current == seq:
+                        return result
+                    self._results[current] = result
+
+    def _read_channels_once(self, timeout: Optional[float]):
+        values = []
+        error: Optional[BaseException] = None
+        for chan in self._output_channels:
+            tag, payload = chan.get(timeout=timeout)
+            if tag == "e":
+                error = payload
+            elif tag == "s":
+                error = RuntimeError("compiled DAG stopped")
+            else:
+                values.append(payload)
+        if error is not None:
+            return error
+        if isinstance(self._root, MultiOutputNode):
+            return values
+        return values[0]
+
+    def teardown(self) -> None:
+        """Stop every loop and release the channels; the actors return
+        to normal method service."""
+        with self._lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            for chan in self._input_channels:
+                try:
+                    chan.put(("s", None), timeout=5)
+                except Exception:
+                    pass
+        import ray_tpu
+
+        for ref in self._loop_refs:
+            try:
+                ray_tpu.get(ref, timeout=10)
+            except Exception:
+                pass
+        for chan in self._all_channels:
+            chan.close()
+            chan.unlink()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

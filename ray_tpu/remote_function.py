@@ -33,6 +33,13 @@ class RemoteFunction:
 
         return submit_function(self, args, kwargs)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this task invocation (reference:
+        python/ray/dag/function_node.py)."""
+        from .dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     # internal
     @property
     def underlying(self):

@@ -1,0 +1,185 @@
+"""DAG tests (reference test model: python/ray/dag/tests/ —
+interpreted bind/execute graphs and compiled actor pipelines over
+channels)."""
+
+import time
+
+import pytest
+
+
+def test_interpreted_task_dag(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(inp))
+    assert rt.get(dag.execute(3), timeout=20) == 12
+    assert rt.get(dag.execute(5), timeout=20) == 20
+
+
+def test_interpreted_actor_dag(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    acc = Acc.remote()
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    assert rt.get(dag.execute(2), timeout=20) == 2
+    assert rt.get(dag.execute(3), timeout=20) == 5
+
+
+def test_shm_channel_roundtrip():
+    from ray_tpu.dag.channels import ShmChannel
+
+    chan = ShmChannel(1 << 16)
+    try:
+        chan.put(("v", [1, 2, 3]))
+        chan.put(("v", "x" * 30000))  # forces wraparound next
+        assert chan.get(timeout=1) == ("v", [1, 2, 3])
+        chan.put(("v", "y" * 30000))
+        assert chan.get(timeout=1)[1] == "x" * 30000
+        assert chan.get(timeout=1)[1] == "y" * 30000
+        with pytest.raises(ValueError):
+            chan.put_bytes(b"z" * (1 << 17))
+    finally:
+        chan.close()
+        chan.unlink()
+
+
+def test_compiled_two_stage_pipeline(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Stage:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def apply(self, x):
+            return x * self.scale
+
+    a = Stage.remote(2)
+    b = Stage.remote(10)
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = b.apply.bind(a.apply.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        # Pipelined executes: submit all, then collect.
+        refs = [compiled.execute(i) for i in range(10)]
+        assert [r.get(timeout=30) for r in refs] == [
+            i * 20 for i in range(10)
+        ]
+    finally:
+        compiled.teardown()
+    # The actors are usable again after teardown.
+    assert rt.get(a.apply.remote(7), timeout=20) == 14
+
+
+def test_compiled_multi_output(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Worker:
+        def __init__(self, k):
+            self.k = k
+
+        def mul(self, x):
+            return x * self.k
+
+    w1, w2 = Worker.remote(3), Worker.remote(5)
+    from ray_tpu.dag import InputNode, MultiOutputNode, experimental_compile
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([w1.mul.bind(inp), w2.mul.bind(inp)])
+    compiled = experimental_compile(dag)
+    try:
+        assert compiled.execute(2).get(timeout=30) == [6, 10]
+        assert compiled.execute(4).get(timeout=30) == [12, 20]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagates(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Flaky:
+        def run(self, x):
+            if x == 13:
+                raise ValueError("unlucky")
+            return x + 1
+
+    @rt.remote
+    class Downstream:
+        def run(self, x):
+            return x * 2
+
+    f, d = Flaky.remote(), Downstream.remote()
+    from ray_tpu.dag import InputNode, experimental_compile
+
+    with InputNode() as inp:
+        dag = d.run.bind(f.run.bind(inp))
+    compiled = experimental_compile(dag)
+    try:
+        assert compiled.execute(1).get(timeout=30) == 4
+        with pytest.raises(ValueError, match="unlucky"):
+            compiled.execute(13).get(timeout=30)
+        # The pipeline keeps working after an error.
+        assert compiled.execute(2).get(timeout=30) == 6
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_throughput_beats_rpc(rt_session):
+    """The point of compiling: channel hops are much cheaper than
+    scheduler round-trips (reference: aDAG motivation)."""
+    rt = rt_session
+
+    @rt.remote
+    class Echo:
+        def hit(self, x):
+            return x
+
+    e = Echo.remote()
+    rt.get(e.hit.remote(0), timeout=20)  # warm the worker
+    n = 200
+
+    start = time.perf_counter()
+    for i in range(n):
+        rt.get(e.hit.remote(i), timeout=20)
+    rpc_time = time.perf_counter() - start
+
+    from ray_tpu.dag import InputNode, experimental_compile
+
+    with InputNode() as inp:
+        dag = e.hit.bind(inp)
+    compiled = experimental_compile(dag)
+    try:
+        compiled.execute(0).get(timeout=30)  # warm the loop
+        start = time.perf_counter()
+        for i in range(n):
+            compiled.execute(i).get(timeout=30)
+        compiled_time = time.perf_counter() - start
+    finally:
+        compiled.teardown()
+    assert compiled_time < rpc_time
