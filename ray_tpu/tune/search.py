@@ -1,0 +1,110 @@
+"""Search spaces and suggestion generation.
+
+Reference: python/ray/tune/search/ — sample domains
+(tune/search/sample.py: uniform/loguniform/randint/choice,
+grid_search), and BasicVariantGenerator (basic_variant.py) which
+crosses grid axes and samples stochastic axes num_samples times.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from itertools import product
+from typing import Any, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        if low <= 0 or high <= 0:
+            raise ValueError("loguniform bounds must be positive")
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(
+            rng.uniform(math.log(self.low), math.log(self.high))
+        )
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high  # [low, high) like the reference
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(value: Any) -> bool:
+    return (
+        isinstance(value, dict)
+        and set(value.keys()) == {"grid_search"}
+    )
+
+
+class BasicVariantGenerator:
+    """Cross product of grid axes × num_samples of stochastic axes
+    (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def generate(
+        self, param_space: Dict[str, Any], num_samples: int
+    ) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
+        grid_values = [param_space[k]["grid_search"] for k in grid_keys]
+        combos = list(product(*grid_values)) if grid_keys else [()]
+        configs = []
+        for _ in range(num_samples):
+            for combo in combos:
+                config: Dict[str, Any] = {}
+                for key, value in param_space.items():
+                    if key in grid_keys:
+                        config[key] = combo[grid_keys.index(key)]
+                    elif isinstance(value, Domain):
+                        config[key] = value.sample(self._rng)
+                    else:
+                        config[key] = value
+                configs.append(config)
+        return configs
