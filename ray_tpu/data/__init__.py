@@ -1,0 +1,30 @@
+"""Distributed datasets (reference: python/ray/data)."""
+
+from .block import Batch, Block
+from .dataset import DataIterator, Dataset, GroupedData
+from .read_api import (
+    from_items,
+    from_numpy,
+    range,  # noqa: A004 — reference API name
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset",
+    "DataIterator",
+    "GroupedData",
+    "Block",
+    "Batch",
+    "range",
+    "from_items",
+    "from_numpy",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+    "read_binary_files",
+]

@@ -1,0 +1,123 @@
+"""Streaming execution of Dataset plans.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:48
+— a pull-based loop moves blocks through operator stages with bounded
+in-flight work (backpressure_policy/). Here each map stage is a window
+of remote tasks over block refs: up to `window` tasks are in flight per
+stage, later stages consume earlier stages' outputs as they are
+submitted, and all-to-all stages (shuffle/sort/repartition) are
+barriers that materialize their input ref list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu as rt
+
+# One remote hop applies a serialized block transform; num_cpus=1 is
+# the reference's default per-map-task resource.
+_map_task = None
+
+
+def _get_map_task():
+    global _map_task
+    if _map_task is None:
+
+        def apply_block_fn(fn, *blocks):
+            return fn(*blocks)
+
+        _map_task = rt.remote(num_cpus=1)(apply_block_fn)
+    return _map_task
+
+
+class Stage:
+    name: str = "stage"
+
+
+class ReadStage(Stage):
+    """Source: a list of argless callables, each producing one block."""
+
+    def __init__(self, tasks: List[Callable[[], Any]], name="read"):
+        self.tasks = tasks
+        self.name = name
+
+
+class MapStage(Stage):
+    """block -> block transform, one remote task per block."""
+
+    def __init__(self, fn: Callable, name="map"):
+        self.fn = fn
+        self.name = name
+
+
+class AllToAllStage(Stage):
+    """Barrier: fn(list_of_refs) -> list_of_refs (it may submit its own
+    remote tasks, e.g. shuffle partition/combine rounds)."""
+
+    def __init__(self, fn: Callable[[List[Any]], List[Any]], name="a2a"):
+        self.fn = fn
+        self.name = name
+
+
+class LimitStage(Stage):
+    def __init__(self, n: int):
+        self.n = n
+        self.name = f"limit({n})"
+
+
+def execute_streaming(
+    stages: List[Stage], window: int = 8
+) -> Iterator[Any]:
+    """Yield output block refs, submitting work stage-by-stage with a
+    bounded per-stage window."""
+    gen: Iterator[Any] = iter(())
+    for stage in stages:
+        if isinstance(stage, ReadStage):
+            gen = _read_gen(stage, window)
+        elif isinstance(stage, MapStage):
+            gen = _map_gen(gen, stage, window)
+        elif isinstance(stage, AllToAllStage):
+            gen = iter(stage.fn(list(gen)))
+        elif isinstance(stage, LimitStage):
+            gen = _limit_gen(gen, stage.n)
+        else:
+            raise TypeError(f"unknown stage {stage!r}")
+    return gen
+
+
+def _read_gen(stage: ReadStage, window: int) -> Iterator[Any]:
+    task = _get_map_task()
+    pending: List[Any] = []
+    for read_fn in stage.tasks:
+        pending.append(task.remote(read_fn))
+        if len(pending) >= window:
+            yield pending.pop(0)
+    while pending:
+        yield pending.pop(0)
+
+
+def _map_gen(
+    upstream: Iterator[Any], stage: MapStage, window: int
+) -> Iterator[Any]:
+    task = _get_map_task()
+    pending: List[Any] = []
+    for ref in upstream:
+        pending.append(task.remote(stage.fn, ref))
+        if len(pending) >= window:
+            yield pending.pop(0)
+    while pending:
+        yield pending.pop(0)
+
+
+def _limit_gen(upstream: Iterator[Any], n: int) -> Iterator[Any]:
+    remaining = n
+    for ref in upstream:
+        if remaining <= 0:
+            return
+        block = rt.get(ref)
+        if len(block) >= remaining:
+            yield rt.put(block[:remaining])
+            return
+        remaining -= len(block)
+        yield ref

@@ -1,0 +1,137 @@
+"""Dataset creation APIs.
+
+Reference: python/ray/data/read_api.py — 35 read/from constructors;
+the ones that matter for TPU input pipelines are implemented natively
+(range/items/numpy + csv/json/jsonl/parquet/text/binary via one read
+task per file), the exotic connector zoo (BigQuery/Mongo/Iceberg/...)
+is out of scope and documented as such.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .dataset import Dataset
+from .executor import ReadStage
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(
+                sorted(
+                    os.path.join(path, f)
+                    for f in os.listdir(path)
+                    if not f.startswith(".")
+                )
+            )
+        elif any(c in path for c in "*?["):
+            out.extend(sorted(_glob.glob(path)))
+        else:
+            out.append(path)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = min(32, max(1, n // 1000 or 1))
+    step = -(-n // parallelism)
+    tasks = []
+    for start in builtins.range(0, n, step):
+        end = min(n, start + step)
+        tasks.append(
+            lambda s=start, e=end: [
+                {"id": i} for i in builtins.range(s, e)
+            ]
+        )
+    return Dataset([ReadStage(tasks, "read_range")])
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    rows = [
+        item if isinstance(item, dict) else {"item": item}
+        for item in items
+    ]
+    if parallelism <= 0:
+        parallelism = min(32, max(1, len(rows) // 100 or 1))
+    step = -(-len(rows) // parallelism) if rows else 1
+    chunks = [
+        rows[i : i + step] for i in builtins.range(0, len(rows), step)
+    ] or [[]]
+    return Dataset(
+        [ReadStage([lambda c=c: c for c in chunks], "from_items")]
+    )
+
+
+def from_numpy(arrays: Dict[str, np.ndarray]) -> Dataset:
+    n = len(next(iter(arrays.values())))
+    rows = [
+        {k: v[i] for k, v in arrays.items()} for i in builtins.range(n)
+    ]
+    return from_items(rows)
+
+
+def _file_read_dataset(paths, read_one, name: str) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset(
+        [ReadStage([lambda p=p: read_one(p) for p in files], name)]
+    )
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    def read_one(path: str):
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path).to_pylist()
+
+    return _file_read_dataset(paths, read_one, "read_csv")
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    def read_one(path: str):
+        import json
+
+        with open(path) as f:
+            text = f.read().strip()
+        if not text:
+            return []
+        if text[0] == "[":
+            return json.loads(text)
+        return [json.loads(line) for line in text.splitlines() if line]
+
+    return _file_read_dataset(paths, read_one, "read_json")
+
+
+def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
+    def read_one(path: str):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path).to_pylist()
+
+    return _file_read_dataset(paths, read_one, "read_parquet")
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    def read_one(path: str):
+        with open(path) as f:
+            return [{"text": line.rstrip("\n")} for line in f]
+
+    return _file_read_dataset(paths, read_one, "read_text")
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    def read_one(path: str):
+        with open(path, "rb") as f:
+            return [{"path": path, "bytes": f.read()}]
+
+    return _file_read_dataset(paths, read_one, "read_binary_files")

@@ -15,7 +15,12 @@ from .config import (
     RunConfig,
     ScalingConfig,
 )
-from .session import get_checkpoint, get_context, report
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from .train_step import (
     TrainState,
     default_optimizer,
@@ -43,6 +48,7 @@ __all__ = [
     "report",
     "get_context",
     "get_checkpoint",
+    "get_dataset_shard",
     "save_checkpoint",
     "restore_checkpoint",
     "load_metadata",

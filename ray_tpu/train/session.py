@@ -27,11 +27,17 @@ class TrainContext:
 
 
 class _Session:
-    def __init__(self, context: TrainContext, result_callback=None):
+    def __init__(
+        self,
+        context: TrainContext,
+        result_callback=None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+    ):
         self.context = context
         self.results: List[Dict[str, Any]] = []
         self.latest_checkpoint: Optional[str] = None
         self._result_callback = result_callback
+        self.dataset_shards = dataset_shards or {}
         self._lock = threading.Lock()
 
     def report(
@@ -65,8 +71,12 @@ class _Session:
 _session_holder = threading.local()
 
 
-def init_session(context: TrainContext, result_callback=None) -> _Session:
-    session = _Session(context, result_callback)
+def init_session(
+    context: TrainContext,
+    result_callback=None,
+    dataset_shards: Optional[Dict[str, Any]] = None,
+) -> _Session:
+    session = _Session(context, result_callback, dataset_shards)
     if context.trial_dir:
         marker = os.path.join(context.trial_dir, _CKPT_MARKER)
         try:
@@ -109,3 +119,16 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Optional[str]:
     session = get_session()
     return session.latest_checkpoint if session else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming shard of the dataset passed to the
+    trainer (reference: train.get_dataset_shard, session.py:1067 — a
+    DataIterator over this rank's split)."""
+    session = get_session()
+    if session is None or name not in session.dataset_shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; pass datasets={{...}} to the "
+            "trainer"
+        )
+    return session.dataset_shards[name]

@@ -91,6 +91,29 @@ class JaxTrainer:
         except (TypeError, ValueError):
             return False
 
+    def _make_shards(self, world_size: int, rank: int):
+        """Streaming-split each dataset; rank's shard only (reference:
+        DataConfig streaming-split, train/_internal/data_config.py:112)."""
+        if not self.datasets:
+            return {}
+        if not hasattr(self, "_split_cache"):
+            self._split_cache = {
+                name: ds.streaming_split(world_size, equal=True)
+                for name, ds in self.datasets.items()
+            }
+        return {
+            name: splits[rank]
+            for name, splits in self._split_cache.items()
+        }
+
+    def _make_gang_shards(self, world_size: int):
+        if not self.datasets:
+            return None
+        return [
+            self._make_shards(world_size, rank)
+            for rank in range(world_size)
+        ]
+
     def _fit_local(self, name: str, storage: str) -> Result:
         """Single-controller path: the loop runs here, pjit spans all
         visible devices."""
@@ -105,7 +128,11 @@ class JaxTrainer:
             experiment_name=name,
             trial_dir=storage,
         )
-        session = init_session(context, result_callback=on_result)
+        session = init_session(
+            context,
+            result_callback=on_result,
+            dataset_shards=self._make_shards(1, rank=0),
+        )
         try:
             self._train_loop(*self._loop_args())
         finally:
@@ -127,7 +154,13 @@ class JaxTrainer:
         try:
             self.backend.on_start(group, self.backend_config)
             outs = group.run_train_loop(
-                self._train_loop, name, self._loop_args(), trial_dir=storage
+                self._train_loop,
+                name,
+                self._loop_args(),
+                trial_dir=storage,
+                dataset_shards_per_rank=self._make_gang_shards(
+                    self.scaling_config.num_workers
+                ),
             )
         finally:
             self.backend.on_shutdown(group)

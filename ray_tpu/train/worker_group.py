@@ -28,7 +28,14 @@ class _TrainWorker:
     def run(self, fn, args=(), kwargs=None):
         return fn(*args, **(kwargs or {}))
 
-    def run_with_context(self, fn, experiment_name="", args=(), trial_dir=None):
+    def run_with_context(
+        self,
+        fn,
+        experiment_name="",
+        args=(),
+        trial_dir=None,
+        dataset_shards=None,
+    ):
         from .session import TrainContext, clear_session, init_session
 
         context = TrainContext(
@@ -38,7 +45,7 @@ class _TrainWorker:
             experiment_name=experiment_name,
             trial_dir=trial_dir,
         )
-        session = init_session(context)
+        session = init_session(context, dataset_shards=dataset_shards)
         try:
             result = fn(*args)
         finally:
@@ -86,11 +93,24 @@ class WorkerGroup:
         return rt.get(refs)
 
     def run_train_loop(
-        self, fn: Callable, experiment_name="", args=(), trial_dir=None
+        self,
+        fn: Callable,
+        experiment_name="",
+        args=(),
+        trial_dir=None,
+        dataset_shards_per_rank=None,
     ):
         refs = [
-            w.run_with_context.remote(fn, experiment_name, args, trial_dir)
-            for w in self.workers
+            w.run_with_context.remote(
+                fn,
+                experiment_name,
+                args,
+                trial_dir,
+                dataset_shards_per_rank[rank]
+                if dataset_shards_per_rank
+                else None,
+            )
+            for rank, w in enumerate(self.workers)
         ]
         return rt.get(refs)
 
