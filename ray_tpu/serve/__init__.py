@@ -1,0 +1,36 @@
+"""Model serving (reference: python/ray/serve)."""
+
+from .api import (
+    delete,
+    get_app_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    batch,
+    deployment,
+)
+from .proxy import Request
+from .router import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "AutoscalingConfig",
+    "batch",
+    "run",
+    "start",
+    "status",
+    "delete",
+    "shutdown",
+    "get_app_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+]

@@ -1,0 +1,166 @@
+"""Serve public API.
+
+Reference: python/ray/serve/api.py — serve.run(app) deploys through
+the controller and returns the ingress handle (:492); serve.start
+brings up HTTP ingress; status/delete/shutdown manage lifecycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from .controller import CONTROLLER_NAME, ServeController
+from .deployment import Application, AutoscalingConfig, Deployment
+from .proxy import Proxy
+from .replica import HandleRef
+from .router import DeploymentHandle
+
+PROXY_NAME = "SERVE_PROXY"
+_NAMESPACE = "serve"
+
+
+def _rt():
+    import ray_tpu as rt
+
+    if not rt.is_initialized():
+        rt.init(ignore_reinit_error=True)
+    return rt
+
+
+def _get_or_create_controller():
+    rt = _rt()
+    try:
+        return rt.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        pass
+    actor_cls = rt.remote(
+        num_cpus=0, name=CONTROLLER_NAME, namespace=_NAMESPACE
+    )(ServeController)
+    handle = actor_cls.remote()
+    # Touch it so creation completed before anyone races lookups.
+    rt.get(handle.status.remote(), timeout=60)
+    return handle
+
+
+def _build_specs(app: Application, app_name: str):
+    """Flatten the bound graph into deployment specs; nested bound
+    deployments become HandleRefs materialized in the replica
+    (reference: build_app + handle injection)."""
+    flat = app.flatten()
+    specs = []
+    for bound in flat:
+        dep: Deployment = bound.deployment
+
+        def convert(value):
+            if isinstance(value, Application):
+                return HandleRef(app_name, value.deployment.name)
+            return value
+
+        batched = {}
+        for attr_name in dir(dep.underlying):
+            attr = getattr(dep.underlying, attr_name, None)
+            cfg = getattr(attr, "__rt_serve_batch__", None)
+            if cfg:
+                batched[attr_name] = cfg
+        specs.append(
+            {
+                "name": dep.name,
+                "cls_blob": cloudpickle.dumps(dep.underlying),
+                "init_args": tuple(convert(a) for a in bound.args),
+                "init_kwargs": {
+                    k: convert(v) for k, v in bound.kwargs.items()
+                },
+                "num_replicas": dep.num_replicas,
+                "actor_options": dep.ray_actor_options,
+                "autoscaling": dataclasses.asdict(dep.autoscaling_config)
+                if dep.autoscaling_config
+                else None,
+                "max_ongoing_requests": dep.max_ongoing_requests,
+                "version": dep.version,
+                "batched_methods": batched,
+                "ingress": bound is flat[-1],
+            }
+        )
+    return specs
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+) -> DeploymentHandle:
+    rt = _rt()
+    controller = _get_or_create_controller()
+    specs = _build_specs(app, name)
+    rt.get(
+        controller.deploy_app.remote(name, route_prefix, specs),
+        timeout=120,
+    )
+    return DeploymentHandle(name, app.deployment.name)
+
+
+def start(http_port: int = 8000) -> int:
+    """Start the HTTP proxy; returns the bound port (reference:
+    serve.start + ProxyActor per node)."""
+    rt = _rt()
+    _get_or_create_controller()
+    try:
+        proxy = rt.get_actor(PROXY_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        actor_cls = rt.remote(
+            num_cpus=0, name=PROXY_NAME, namespace=_NAMESPACE
+        )(Proxy)
+        proxy = actor_cls.remote(http_port)
+    return rt.get(proxy.ready.remote(), timeout=60)
+
+
+def status() -> Dict[str, Any]:
+    rt = _rt()
+    controller = _get_or_create_controller()
+    return rt.get(controller.status.remote(), timeout=30)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    rt = _rt()
+    controller = _get_or_create_controller()
+    state = rt.get(controller.status.remote(), timeout=30)
+    if name not in state:
+        raise ValueError(f"no application {name!r}")
+    routes = rt.get(controller.get_routes.remote(), timeout=30)
+    for _, (app, ingress) in routes.items():
+        if app == name:
+            return DeploymentHandle(name, ingress)
+    # Route-less app: find its ingress via status order.
+    raise ValueError(f"application {name!r} has no ingress route")
+
+
+def delete(name: str) -> None:
+    rt = _rt()
+    controller = _get_or_create_controller()
+    rt.get(controller.delete_app.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    rt = _rt()
+    try:
+        controller = rt.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        return
+    try:
+        rt.get(controller.shutdown_all.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        proxy = rt.get_actor(PROXY_NAME, namespace=_NAMESPACE)
+        rt.get(proxy.stop.remote(), timeout=10)
+        rt.kill(proxy)
+    except Exception:
+        pass
+    try:
+        rt.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,258 @@
+"""Serve controller actor.
+
+Reference: python/ray/serve/_private/controller.py:84 — a singleton
+controller reconciles declared application/deployment state against
+live replica actors (deployment_state.py), autoscales on reported
+ongoing-request load (autoscaling_state.py), and serves route +
+replica-membership lookups to routers/proxies (long_poll.py is
+approximated by short-TTL polling).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        import ray_tpu as rt
+
+        self._rt = rt
+        self._lock = threading.RLock()
+        # apps[app] = {"route_prefix", "ingress", "deployments": {name: spec}}
+        self._apps: Dict[str, dict] = {}
+        # replicas[(app, dep)] = [{"id", "actor", "version"}]
+        self._replicas: Dict[Tuple[str, str], List[dict]] = {}
+        # handle metrics: {(app, dep): {handle_id: (ts, ongoing)}}
+        self._metrics: Dict[Tuple[str, str], Dict[str, tuple]] = {}
+        self._desired_since: Dict[Tuple[str, str], tuple] = {}
+        self._replica_seq = 0
+        self._shutdown = False
+        self._autoscaler = threading.Thread(
+            target=self._autoscale_loop, daemon=True
+        )
+        self._autoscaler.start()
+
+    # -- deploy --------------------------------------------------------
+    def deploy_app(
+        self, app_name: str, route_prefix: Optional[str], specs: List[dict]
+    ) -> bool:
+        with self._lock:
+            ingress = next(s["name"] for s in specs if s.get("ingress"))
+            self._apps[app_name] = {
+                "route_prefix": route_prefix,
+                "ingress": ingress,
+                "deployments": {s["name"]: s for s in specs},
+            }
+        for spec in specs:
+            self._reconcile_deployment(app_name, spec)
+        return True
+
+    def _reconcile_deployment(self, app: str, spec: dict) -> None:
+        key = (app, spec["name"])
+        with self._lock:
+            existing = self._replicas.setdefault(key, [])
+            # Version change: replace every replica (reference:
+            # deployment_state rolling update, simplified to recreate).
+            stale = [
+                r for r in existing if r["version"] != spec["version"]
+            ]
+            keep = [r for r in existing if r["version"] == spec["version"]]
+            self._replicas[key] = keep
+        for replica in stale:
+            self._stop_replica(replica)
+        target = spec["num_replicas"]
+        if spec.get("autoscaling"):
+            target = max(
+                spec["autoscaling"]["min_replicas"],
+                min(target, spec["autoscaling"]["max_replicas"]),
+            )
+        self._scale_to(app, spec, target)
+
+    def _scale_to(self, app: str, spec: dict, target: int) -> None:
+        key = (app, spec["name"])
+        while True:
+            with self._lock:
+                current = len(self._replicas[key])
+                if current >= target:
+                    excess = self._replicas[key][target:]
+                    self._replicas[key] = self._replicas[key][:target]
+                else:
+                    excess = None
+            if excess is not None:
+                for replica in excess:
+                    self._stop_replica(replica)
+                return
+            self._start_replica(app, spec)
+
+    def _start_replica(self, app: str, spec: dict) -> None:
+        import cloudpickle
+
+        from .replica import Replica
+
+        with self._lock:
+            self._replica_seq += 1
+            replica_id = f"{app}#{spec['name']}#{self._replica_seq}"
+        options = dict(spec.get("actor_options") or {})
+        options.setdefault("num_cpus", 1)
+        actor_cls = self._rt.remote(**options)(Replica)
+        handle = actor_cls.remote(
+            cloudpickle.loads(spec["cls_blob"]),
+            spec["init_args"],
+            spec["init_kwargs"],
+            replica_id,
+        )
+        # Block until the replica's constructor ran (readiness probe).
+        self._rt.get(handle.ping.remote(), timeout=60)
+        with self._lock:
+            self._replicas[(app, spec["name"])].append(
+                {
+                    "id": replica_id,
+                    "actor": handle,
+                    "version": spec["version"],
+                }
+            )
+
+    def _stop_replica(self, replica: dict) -> None:
+        try:
+            self._rt.kill(replica["actor"])
+        except Exception:
+            pass
+
+    # -- lookups -------------------------------------------------------
+    def get_routes(self) -> Dict[str, Tuple[str, str]]:
+        with self._lock:
+            return {
+                state["route_prefix"]: (app, state["ingress"])
+                for app, state in self._apps.items()
+                if state["route_prefix"]
+            }
+
+    def get_replicas(self, app: str, deployment: str) -> List[dict]:
+        with self._lock:
+            return [
+                {"id": r["id"], "actor": r["actor"]}
+                for r in self._replicas.get((app, deployment), [])
+            ]
+
+    def get_deployment_spec(self, app: str, deployment: str) -> dict:
+        with self._lock:
+            spec = self._apps[app]["deployments"][deployment]
+            return {
+                k: spec[k]
+                for k in (
+                    "name",
+                    "num_replicas",
+                    "version",
+                    "batched_methods",
+                    "autoscaling",
+                )
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                app: {
+                    "route_prefix": state["route_prefix"],
+                    "deployments": {
+                        name: {
+                            "replicas": len(
+                                self._replicas.get((app, name), [])
+                            ),
+                            "version": spec["version"],
+                        }
+                        for name, spec in state["deployments"].items()
+                    },
+                }
+                for app, state in self._apps.items()
+            }
+
+    # -- autoscaling ---------------------------------------------------
+    def report_metrics(
+        self, app: str, deployment: str, handle_id: str, ongoing: float
+    ) -> None:
+        with self._lock:
+            self._metrics.setdefault((app, deployment), {})[
+                handle_id
+            ] = (time.time(), ongoing)
+
+    def _autoscale_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(0.25)
+            try:
+                self._autoscale_tick()
+            except Exception:
+                pass
+
+    def _autoscale_tick(self) -> None:
+        now = time.time()
+        with self._lock:
+            work = []
+            for app, state in self._apps.items():
+                for name, spec in state["deployments"].items():
+                    cfg = spec.get("autoscaling")
+                    if not cfg:
+                        continue
+                    reports = self._metrics.get((app, name), {})
+                    ongoing = sum(
+                        value
+                        for ts, value in reports.values()
+                        if now - ts < 2.0
+                    )
+                    current = len(self._replicas.get((app, name), []))
+                    desired = max(
+                        cfg["min_replicas"],
+                        min(
+                            cfg["max_replicas"],
+                            math.ceil(
+                                ongoing
+                                / max(
+                                    cfg["target_ongoing_requests"], 1e-9
+                                )
+                            ),
+                        ),
+                    )
+                    key = (app, name)
+                    prev = self._desired_since.get(key)
+                    if prev is None or prev[0] != desired:
+                        self._desired_since[key] = (desired, now)
+                        continue
+                    held = now - prev[1]
+                    delay = (
+                        cfg["upscale_delay_s"]
+                        if desired > current
+                        else cfg["downscale_delay_s"]
+                    )
+                    if desired != current and held >= delay:
+                        work.append((app, dict(spec), desired))
+        for app, spec, desired in work:
+            self._scale_to(app, spec, desired)
+
+    # -- teardown ------------------------------------------------------
+    def delete_app(self, app_name: str) -> bool:
+        with self._lock:
+            state = self._apps.pop(app_name, None)
+            if state is None:
+                return False
+            keys = [
+                (app_name, name) for name in state["deployments"]
+            ]
+            doomed = []
+            for key in keys:
+                doomed.extend(self._replicas.pop(key, []))
+        for replica in doomed:
+            self._stop_replica(replica)
+        return True
+
+    def shutdown_all(self) -> bool:
+        with self._lock:
+            apps = list(self._apps)
+        for app in apps:
+            self.delete_app(app)
+        self._shutdown = True
+        return True

@@ -1,0 +1,155 @@
+"""HTTP ingress proxy.
+
+Reference: python/ray/serve/_private/proxy.py:1135 — a per-node proxy
+actor terminates HTTP and routes by path prefix to the application's
+ingress deployment. The reference runs uvicorn/starlette (ASGI); here
+a stdlib ThreadingHTTPServer thread inside the proxy actor serves the
+same role, and the request surface handed to the ingress __call__ is a
+small Request object (method/path/query/headers/body/json).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class Request:
+    """What the ingress deployment's __call__ receives."""
+
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query_params: Dict[str, str],
+        headers: Dict[str, str],
+        body: bytes,
+    ):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body)
+
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class Proxy:
+    """Proxy actor body: serves HTTP on `port`, routes to ingress
+    handles via longest-prefix match."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_ts = 0.0
+        self._handles: Dict[Tuple[str, str], Any] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _serve(self):
+                try:
+                    status, payload, ctype = proxy._dispatch(self)
+                except Exception as e:  # noqa: BLE001 — 500 surface
+                    status = 500
+                    payload = json.dumps({"error": repr(e)}).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    # -- routing -------------------------------------------------------
+    def _refresh_routes(self, force: bool = False) -> None:
+        import ray_tpu as rt
+
+        from .controller import CONTROLLER_NAME
+
+        if not force and time.time() - self._routes_ts < 2.0:
+            return
+        controller = rt.get_actor(CONTROLLER_NAME, namespace="serve")
+        self._routes = rt.get(
+            controller.get_routes.remote(), timeout=30
+        )
+        self._routes_ts = time.time()
+
+    def _match(self, path: str):
+        best = None
+        for prefix, target in self._routes.items():
+            if path == prefix or path.startswith(
+                prefix.rstrip("/") + "/"
+            ) or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best
+
+    def _dispatch(self, handler) -> Tuple[int, bytes, str]:
+        from .router import DeploymentHandle
+
+        parsed = urlparse(handler.path)
+        self._refresh_routes()
+        match = self._match(parsed.path)
+        if match is None:
+            self._refresh_routes(force=True)
+            match = self._match(parsed.path)
+        if match is None:
+            return (
+                404,
+                json.dumps({"error": "no route"}).encode(),
+                "application/json",
+            )
+        prefix, (app, ingress) = match
+        key = (app, ingress)
+        if key not in self._handles:
+            self._handles[key] = DeploymentHandle(app, ingress)
+        length = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(length) if length else b""
+        request = Request(
+            method=handler.command,
+            path=parsed.path[len(prefix.rstrip("/")) :] or "/",
+            query_params={
+                k: v[0] for k, v in parse_qs(parsed.query).items()
+            },
+            headers=dict(handler.headers.items()),
+            body=body,
+        )
+        value = self._handles[key].remote(request).result(timeout=60)
+        if isinstance(value, bytes):
+            return 200, value, "application/octet-stream"
+        if isinstance(value, str):
+            return 200, value.encode(), "text/plain"
+        return (
+            200,
+            json.dumps(value, default=str).encode(),
+            "application/json",
+        )
+
+    def ready(self) -> int:
+        return self.port
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        return True

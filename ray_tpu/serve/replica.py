@@ -1,0 +1,79 @@
+"""Replica actor body.
+
+Reference: python/ray/serve/_private/replica.py:750,998 — a replica
+wraps the user callable; requests arrive as (method, args, kwargs);
+handle-typed init args are materialized into live DeploymentHandles so
+composed models call downstream deployments through the router.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+
+class HandleRef:
+    """Placeholder for a DeploymentHandle in pickled init args."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+
+
+class Replica:
+    def __init__(
+        self,
+        cls,
+        init_args: tuple,
+        init_kwargs: dict,
+        replica_id: str,
+    ):
+        from .router import DeploymentHandle
+
+        def materialize(value: Any) -> Any:
+            if isinstance(value, HandleRef):
+                return DeploymentHandle(
+                    value.app_name, value.deployment_name
+                )
+            return value
+
+        args = tuple(materialize(a) for a in init_args)
+        kwargs = {k: materialize(v) for k, v in init_kwargs.items()}
+        self._instance = cls(*args, **kwargs)
+        self.replica_id = replica_id
+        self._served = 0
+        self._started = time.time()
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        self._served += 1
+        target = (
+            self._instance
+            if method == "__call__"
+            else getattr(self._instance, method)
+        )
+        if method == "__call__":
+            return target(*args, **kwargs)
+        return target(*args, **kwargs)
+
+    def handle_batch(self, method: str, batched_args: list):
+        """One call carrying many requests; the user method receives
+        the list (reference: serve/batching.py _BatchQueue)."""
+        self._served += len(batched_args)
+        target = getattr(self._instance, method)
+        return target([a[0] if len(a) == 1 else a for a in batched_args])
+
+    def stats(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "served": self._served,
+            "uptime_s": time.time() - self._started,
+        }
+
+    def reconfigure(self, user_config: Any) -> None:
+        if hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,312 @@
+"""DeploymentHandle + power-of-two-choices routing.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle /
+DeploymentResponse) and _private/replica_scheduler/pow_2_scheduler.py:52
+— pick two random replicas, send to the one with fewer ongoing
+requests tracked by this router. Batched methods group concurrent
+calls handle-side into one replica call (reference: serve/batching.py,
+relocated to the router because replicas execute serially here).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .controller import CONTROLLER_NAME
+
+_REPLICA_CACHE_TTL = 1.0
+
+
+def _controller():
+    import ray_tpu as rt
+
+    return rt.get_actor(CONTROLLER_NAME, namespace="serve")
+
+
+class DeploymentResponse:
+    """Future for one request (reference: serve/handle.py
+    DeploymentResponse.result())."""
+
+    def __init__(self, waiter, router: "DeploymentHandle"):
+        self._waiter = waiter  # callable(timeout) -> value
+        self._router = router
+        self._resolved = False
+        self._value = None
+
+    def result(self, timeout: Optional[float] = 30.0):
+        if not self._resolved:
+            try:
+                self._value = self._waiter(timeout)
+            finally:
+                self._router._ongoing_done(
+                    getattr(self, "_replica_id", None)
+                )
+            self._resolved = True
+        if isinstance(self._value, BaseException):
+            raise self._value
+        return self._value
+
+
+class _BatchQueue:
+    """Handle-side batcher for @serve.batch methods."""
+
+    def __init__(self, handle: "DeploymentHandle", method: str, cfg: dict):
+        self._handle = handle
+        self._method = method
+        self._max = cfg["max_batch_size"]
+        self._wait = cfg["batch_wait_timeout_s"]
+        self._lock = threading.Lock()
+        self._pending: List[dict] = []
+        self._timer: Optional[threading.Timer] = None
+
+    def submit(self, args: tuple) -> "DeploymentResponse":
+        entry = {
+            "args": args,
+            "event": threading.Event(),
+            "value": None,
+        }
+        flush_now = False
+        with self._lock:
+            self._pending.append(entry)
+            if len(self._pending) >= self._max:
+                flush_now = True
+            elif self._timer is None:
+                self._timer = threading.Timer(self._wait, self._flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._flush()
+        self._handle._ongoing_sent()
+
+        def waiter(timeout):
+            if not entry["event"].wait(timeout):
+                raise TimeoutError(
+                    f"batched call to {self._method} timed out"
+                )
+            return entry["value"]
+
+        return DeploymentResponse(waiter, self._handle)
+
+    def _flush(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            batch, self._pending = self._pending, []
+        if not batch:
+            return
+        import ray_tpu as rt
+
+        replica = self._handle._pick_replica()
+        ref = replica["actor"].handle_batch.remote(
+            self._method, [e["args"] for e in batch]
+        )
+
+        def deliver():
+            try:
+                values = rt.get(ref, timeout=60)
+                if not isinstance(values, list) or len(values) != len(
+                    batch
+                ):
+                    raise ValueError(
+                        "@serve.batch method must return a list with "
+                        "one output per input"
+                    )
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                values = [e] * len(batch)
+            for entry, value in zip(batch, values):
+                entry["value"] = value
+                entry["event"].set()
+
+        threading.Thread(target=deliver, daemon=True).start()
+
+
+class DeploymentHandle:
+    def __init__(
+        self,
+        app_name: str,
+        deployment_name: str,
+        method_name: str = "__call__",
+    ):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._handle_id = uuid.uuid4().hex[:8]
+        self._lock = threading.Lock()
+        self._replicas: List[dict] = []
+        self._replicas_ts = 0.0
+        self._spec: Optional[dict] = None
+        self._ongoing: Dict[str, int] = {}  # replica_id -> in flight
+        self._sent = 0
+        self._done = 0
+        self._batchers: Dict[str, _BatchQueue] = {}
+        self._reporter: Optional[threading.Thread] = None
+
+    # -- routing -------------------------------------------------------
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        with self._lock:
+            fresh = (
+                not force
+                and self._replicas
+                and now - self._replicas_ts < _REPLICA_CACHE_TTL
+            )
+        if fresh:
+            return
+        import ray_tpu as rt
+
+        controller = _controller()
+        replicas = rt.get(
+            controller.get_replicas.remote(
+                self.app_name, self.deployment_name
+            ),
+            timeout=30,
+        )
+        spec = rt.get(
+            controller.get_deployment_spec.remote(
+                self.app_name, self.deployment_name
+            ),
+            timeout=30,
+        )
+        with self._lock:
+            self._replicas = replicas
+            self._replicas_ts = now
+            self._spec = spec
+
+    def _pick_replica(self) -> dict:
+        self._refresh()
+        deadline = time.time() + 30
+        while True:
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self.app_name}/"
+                    f"{self.deployment_name}"
+                )
+            time.sleep(0.05)
+            self._refresh(force=True)
+        if len(replicas) == 1:
+            return replicas[0]
+        # Power of two choices on this router's in-flight counts.
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            na = self._ongoing.get(a["id"], 0)
+            nb = self._ongoing.get(b["id"], 0)
+        return a if na <= nb else b
+
+    def _ongoing_sent(self, replica_id: Optional[str] = None) -> None:
+        with self._lock:
+            self._sent += 1
+            if replica_id:
+                self._ongoing[replica_id] = (
+                    self._ongoing.get(replica_id, 0) + 1
+                )
+        self._ensure_reporter()
+
+    def _ongoing_done(self, replica_id: Optional[str] = None) -> None:
+        with self._lock:
+            self._done += 1
+            if replica_id and self._ongoing.get(replica_id, 0) > 0:
+                self._ongoing[replica_id] -= 1
+
+    def _ensure_reporter(self) -> None:
+        """Push ongoing-load metrics to the controller for autoscaling
+        (reference: autoscaling_state consumes handle metrics)."""
+        with self._lock:
+            if self._reporter is not None:
+                return
+            self._reporter = threading.Thread(
+                target=self._report_loop, daemon=True
+            )
+            self._reporter.start()
+
+    def _report_loop(self) -> None:
+        import ray_tpu as rt
+
+        while True:
+            time.sleep(0.25)
+            try:
+                controller = _controller()
+                with self._lock:
+                    ongoing = self._sent - self._done
+                controller.report_metrics.remote(
+                    self.app_name,
+                    self.deployment_name,
+                    self._handle_id,
+                    float(max(0, ongoing)),
+                )
+            except Exception:
+                return
+
+    # -- calls ---------------------------------------------------------
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        clone = DeploymentHandle(
+            self.app_name, self.deployment_name, name
+        )
+        # Share the routing state so ongoing counts aggregate.
+        clone.__dict__.update(
+            {
+                k: self.__dict__[k]
+                for k in (
+                    "_handle_id",
+                    "_lock",
+                    "_replicas",
+                    "_replicas_ts",
+                    "_spec",
+                    "_ongoing",
+                    "_batchers",
+                )
+            }
+        )
+        clone._method = name
+        return clone
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        with self._lock:
+            batched = (self._spec or {}).get("batched_methods", {}).get(
+                self._method
+            )
+        if batched:
+            with self._lock:
+                batcher = self._batchers.get(self._method)
+                if batcher is None:
+                    batcher = _BatchQueue(self, self._method, batched)
+                    self._batchers[self._method] = batcher
+            if kwargs:
+                raise TypeError(
+                    "@serve.batch methods take positional args only"
+                )
+            return batcher.submit(args)
+        replica = self._pick_replica()
+        ref = replica["actor"].handle_request.remote(
+            self._method, args, kwargs
+        )
+        self._ongoing_sent(replica["id"])
+
+        def waiter(timeout):
+            import ray_tpu as rt
+
+            try:
+                return rt.get(ref, timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 — surfaced at
+                return e  # .result()
+
+        response = DeploymentResponse(waiter, self)
+        response._replica_id = replica["id"]
+        return response
+
+    def __reduce__(self):
+        return (
+            DeploymentHandle,
+            (self.app_name, self.deployment_name, self._method),
+        )

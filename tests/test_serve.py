@@ -1,0 +1,235 @@
+"""Serve tests (reference test model: python/ray/serve/tests/ —
+deploy/handle calls, composition, scaling, redeploy, HTTP ingress,
+batching)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def serve_session(rt_session):
+    import ray_tpu.serve as serve
+
+    yield rt_session, serve
+    serve.shutdown()
+
+
+def test_deploy_and_handle_call(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+        def triple(self, x):
+            return 3 * x
+
+    handle = serve.run(Doubler.bind(), name="app1", route_prefix=None)
+    assert handle.remote(21).result(timeout=30) == 42
+    assert handle.triple.remote(7).result(timeout=30) == 21
+
+
+def test_composition_with_downstream_handle(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def __call__(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            partial = self.adder.remote(x).result(timeout=30)
+            return partial * 10
+
+    handle = serve.run(
+        Ingress.bind(Adder.bind(5)), name="app2", route_prefix=None
+    )
+    assert handle.remote(1).result(timeout=30) == 60
+
+
+def test_multiple_replicas_share_load(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __call__(self, _):
+            import os
+            import time as _t
+
+            _t.sleep(0.2)
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind(), name="app3", route_prefix=None)
+    responses = [handle.remote(i) for i in range(9)]
+    pids = {r.result(timeout=60) for r in responses}
+    assert len(pids) >= 2
+
+
+def test_error_propagates(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Boom:
+        def __call__(self, x):
+            raise ValueError("kapow")
+
+    handle = serve.run(Boom.bind(), name="app4", route_prefix=None)
+    with pytest.raises(Exception, match="kapow"):
+        handle.remote(1).result(timeout=30)
+
+
+def test_redeploy_new_version(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment(version="1")
+    class Model:
+        def __call__(self, x):
+            return "v1"
+
+    h1 = serve.run(Model.bind(), name="app5", route_prefix=None)
+    assert h1.remote(0).result(timeout=30) == "v1"
+
+    @serve.deployment(name="Model", version="2")
+    class Model2:
+        def __call__(self, x):
+            return "v2"
+
+    h2 = serve.run(Model2.bind(), name="app5", route_prefix=None)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if h2.remote(0).result(timeout=30) == "v2":
+            break
+        time.sleep(0.2)
+    assert h2.remote(0).result(timeout=30) == "v2"
+
+
+def test_http_ingress(serve_session):
+    rt, serve = serve_session
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    @serve.deployment
+    class Api:
+        def __call__(self, request):
+            if request.method == "GET":
+                return {
+                    "path": request.path,
+                    "q": request.query_params.get("q"),
+                }
+            data = request.json()
+            return {"sum": data["a"] + data["b"]}
+
+    serve.run(Api.bind(), name="default", route_prefix="/api")
+    serve.start(http_port=port)
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/hello?q=1", timeout=30
+    ) as resp:
+        body = json.loads(resp.read())
+    assert body == {"path": "/hello", "q": "1"}
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api",
+        data=json.dumps({"a": 2, "b": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read()) == {"sum": 5}
+
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30
+        )
+
+
+def test_batching_groups_requests(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def predict(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        def seen(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), name="app6", route_prefix=None)
+    responses = [handle.predict.remote(i) for i in range(8)]
+    values = sorted(r.result(timeout=30) for r in responses)
+    assert values == [i * 10 for i in range(8)]
+    sizes = handle.seen.remote().result(timeout=30)
+    assert max(sizes) > 1  # at least one real batch formed
+
+
+def test_autoscaling_scales_up(serve_session):
+    rt, serve = serve_session
+
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "upscale_delay_s": 0.3,
+            "downscale_delay_s": 60.0,
+        }
+    )
+    class Slow:
+        def __call__(self, _):
+            import time as _t
+
+            _t.sleep(0.4)
+            return 1
+
+    handle = serve.run(Slow.bind(), name="app7", route_prefix=None)
+    assert serve.status()["app7"]["deployments"]["Slow"]["replicas"] == 1
+
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                handle.remote(0).result(timeout=30)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 20
+        scaled = False
+        while time.time() < deadline:
+            replicas = serve.status()["app7"]["deployments"]["Slow"][
+                "replicas"
+            ]
+            if replicas >= 2:
+                scaled = True
+                break
+            time.sleep(0.25)
+        assert scaled, "deployment never scaled past 1 replica"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
