@@ -1,0 +1,19 @@
+"""Reinforcement learning (reference: rllib/ new API stack —
+EnvRunnerGroup + Learner + Algorithm)."""
+
+from .env import ENV_REGISTRY, CartPoleEnv, VectorEnv, make_env
+from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from .learner import JaxLearner
+from .ppo import PPO, PPOConfig
+
+__all__ = [
+    "CartPoleEnv",
+    "VectorEnv",
+    "ENV_REGISTRY",
+    "make_env",
+    "SingleAgentEnvRunner",
+    "EnvRunnerGroup",
+    "JaxLearner",
+    "PPO",
+    "PPOConfig",
+]

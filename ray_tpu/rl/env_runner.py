@@ -1,0 +1,167 @@
+"""Env runners: parallel rollout collection.
+
+Reference: rllib/env/env_runner_group.py:70 + single_agent_env_runner
+— a group of actor-hosted runners samples with the current policy and
+returns batches; weights broadcast after each learner update. GAE is
+computed runner-side at sample time (reference: ConnectorV2
+GeneralAdvantageEstimation on the learner pipeline; moved here so the
+learner consumes ready minibatches).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    """Actor body: vectorized envs + CPU policy inference."""
+
+    def __init__(
+        self,
+        env_spec,
+        num_envs: int = 8,
+        rollout_length: int = 64,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        seed: int = 0,
+    ):
+        import jax
+
+        from .env import VectorEnv, make_env
+
+        self.vec = VectorEnv(
+            lambda s: make_env(env_spec, seed=s), num_envs, seed=seed
+        )
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.lam = gae_lambda
+        self.params = None
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = self.vec.reset()
+        # Per-env accumulators for episode-return reporting.
+        self._ep_returns = np.zeros(num_envs)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        from .models import sample_actions
+
+        assert self.params is not None, "set_weights first"
+        T, N = self.rollout_length, self.vec.num_envs
+        obs_buf = np.zeros((T, N, self._obs.shape[1]), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        for t in range(T):
+            actions, logp, values, self._key = sample_actions(
+                self.params, self._obs, self._key
+            )
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            val_buf[t] = values
+            next_obs, rewards, terminated, truncated = self.vec.step(
+                actions
+            )
+            rew_buf[t] = rewards
+            # GAE bootstraps through truncation but not termination.
+            done_buf[t] = terminated
+            self._ep_returns += rewards
+            for i in range(N):
+                if terminated[i] or truncated[i]:
+                    self._finished_returns.append(
+                        float(self._ep_returns[i])
+                    )
+                    self._ep_returns[i] = 0.0
+            self._obs = next_obs
+        _, _, last_values, self._key = sample_actions(
+            self.params, self._obs, self._key
+        )
+        adv = np.zeros((T, N), np.float32)
+        last_gae = np.zeros(N, np.float32)
+        for t in reversed(range(T)):
+            next_value = val_buf[t + 1] if t + 1 < T else last_values
+            nonterminal = 1.0 - done_buf[t].astype(np.float32)
+            delta = (
+                rew_buf[t]
+                + self.gamma * next_value * nonterminal
+                - val_buf[t]
+            )
+            last_gae = (
+                delta
+                + self.gamma * self.lam * nonterminal * last_gae
+            )
+            adv[t] = last_gae
+        returns = adv + val_buf
+        flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+        episode_returns = self._finished_returns
+        self._finished_returns = []
+        return {
+            "obs": flat(obs_buf),
+            "actions": flat(act_buf),
+            "logp": flat(logp_buf),
+            "advantages": flat(adv),
+            "value_targets": flat(returns),
+            "episode_returns": np.asarray(episode_returns, np.float32),
+        }
+
+
+class EnvRunnerGroup:
+    """Fan-out over runner actors (reference: env_runner_group.py
+    sample + weight sync)."""
+
+    def __init__(
+        self,
+        env_spec,
+        num_env_runners: int = 2,
+        num_envs_per_runner: int = 8,
+        rollout_length: int = 64,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        seed: int = 0,
+    ):
+        import ray_tpu as rt
+
+        self._rt = rt
+        runner_cls = rt.remote(num_cpus=1)(SingleAgentEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                env_spec,
+                num_envs_per_runner,
+                rollout_length,
+                gamma,
+                gae_lambda,
+                seed + 1000 * i,
+            )
+            for i in range(num_env_runners)
+        ]
+
+    def sync_weights(self, params) -> None:
+        ref = self._rt.put(params)
+        self._rt.get(
+            [r.set_weights.remote(ref) for r in self.runners],
+            timeout=120,
+        )
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        batches = self._rt.get(
+            [r.sample.remote() for r in self.runners], timeout=300
+        )
+        return {
+            key: np.concatenate([b[key] for b in batches])
+            for key in batches[0]
+        }
+
+    def shutdown(self) -> None:
+        for runner in self.runners:
+            try:
+                self._rt.kill(runner)
+            except Exception:
+                pass

@@ -1,0 +1,131 @@
+"""JaxLearner: the gradient-update half of the algorithm.
+
+Reference: rllib/core/learner/ — TorchLearner wraps DDP around
+compute_gradients/apply_gradients (torch_learner.py:171,192) and
+LearnerGroup fans batches across learner actors via Train's backend
+executor (learner_group.py:81,167). TPU-native replacement: ONE
+learner process whose jitted update spans the whole device mesh via
+GSPMD (data-parallel minibatch sharding with psum'd gradients happens
+inside XLA), so multi-chip scaling needs no actor-side gradient
+plumbing. A multi-host LearnerGroup is the Train gang (JaxBackend
+rendezvous) running this same learner under pjit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .models import apply_policy, init_policy_params
+
+
+class JaxLearner:
+    def __init__(
+        self,
+        obs_size: int,
+        num_actions: int,
+        *,
+        lr: float = 3e-4,
+        clip_eps: float = 0.2,
+        vf_coef: float = 0.5,
+        entropy_coef: float = 0.01,
+        minibatch_size: int = 256,
+        num_epochs: int = 4,
+        max_grad_norm: float = 0.5,
+        hidden: Tuple[int, ...] = (64, 64),
+        seed: int = 0,
+    ):
+        self.params = init_policy_params(
+            jax.random.PRNGKey(seed), obs_size, num_actions, hidden
+        )
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adam(lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.clip_eps = clip_eps
+        self.vf_coef = vf_coef
+        self.entropy_coef = entropy_coef
+        self.minibatch_size = minibatch_size
+        self.num_epochs = num_epochs
+        self._rng = np.random.default_rng(seed)
+        self._update_jit = jax.jit(self._minibatch_update)
+
+    # -- PPO loss (reference: ppo_torch_learner compute_loss) ---------
+    def _loss(self, params, batch):
+        logits, values = apply_policy(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = (
+            jnp.clip(ratio, 1 - self.clip_eps, 1 + self.clip_eps) * adv
+        )
+        policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        vf_loss = jnp.mean((values - batch["value_targets"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jax.nn.softmax(logits) * logp_all, axis=1)
+        )
+        total = (
+            policy_loss
+            + self.vf_coef * vf_loss
+            - self.entropy_coef * entropy
+        )
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def _minibatch_update(self, params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            self._loss, has_aux=True
+        )(params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics, total_loss=loss)
+        return params, opt_state, metrics
+
+    # -- public --------------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Epochs of shuffled minibatch SGD over one sample batch
+        (reference: ppo.py training_step's learner update)."""
+        n = len(batch["obs"])
+        device_batch = {
+            "obs": jnp.asarray(batch["obs"]),
+            "actions": jnp.asarray(batch["actions"]),
+            "logp": jnp.asarray(batch["logp"]),
+            "advantages": jnp.asarray(batch["advantages"]),
+            "value_targets": jnp.asarray(batch["value_targets"]),
+        }
+        metrics = {}
+        for _ in range(self.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n, self.minibatch_size):
+                idx = perm[start : start + self.minibatch_size]
+                if len(idx) < self.minibatch_size and start > 0:
+                    continue  # drop ragged tail (static jit shapes)
+                minibatch = {
+                    k: v[idx] for k, v in device_batch.items()
+                }
+                self.params, self.opt_state, metrics = (
+                    self._update_jit(
+                        self.params, self.opt_state, minibatch
+                    )
+                )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)

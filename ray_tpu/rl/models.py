@@ -1,0 +1,76 @@
+"""Policy/value network.
+
+Reference: rllib/core/rl_module/ — an RLModule owns the neural nets
+for action distribution + value function. TPU-native form: a pure
+functional jax MLP (params pytree + apply), so the same module runs
+in env runners (CPU inference) and under pjit in the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_policy_params(
+    key, obs_size: int, num_actions: int, hidden: Tuple[int, ...] = (64, 64)
+) -> Dict:
+    sizes = (obs_size, *hidden)
+    params = {"layers": [], "pi": None, "vf": None}
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w = jax.random.orthogonal(keys[i], max(fan_in, fan_out))[
+            :fan_in, :fan_out
+        ] * jnp.sqrt(2.0)
+        params["layers"].append(
+            {"w": w, "b": jnp.zeros((fan_out,))}
+        )
+    params["pi"] = {
+        "w": jax.random.orthogonal(keys[-2], max(hidden[-1], num_actions))[
+            :hidden[-1], :num_actions
+        ]
+        * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.orthogonal(keys[-1], hidden[-1])[:, :1],
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def apply_policy(params: Dict, obs: jnp.ndarray):
+    """obs [B, obs_size] -> (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value
+
+
+@jax.jit
+def _sample_jit(params, obs, key):
+    logits, value = apply_policy(params, obs)
+    key, sub = jax.random.split(key)
+    actions = jax.random.categorical(sub, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(logits.shape[0]), actions
+    ]
+    return actions, logp, value, key
+
+
+def sample_actions(params: Dict, obs: np.ndarray, key):
+    """Inference-side sampling used by env runners."""
+    actions, logp, value, key = _sample_jit(
+        params, jnp.asarray(obs), key
+    )
+    return (
+        np.asarray(actions),
+        np.asarray(logp),
+        np.asarray(value),
+        key,
+    )

@@ -1,0 +1,103 @@
+"""RL tests (reference test model: rllib smoke tests — env mechanics,
+runner batch shapes, and a PPO learning regression on CartPole with a
+reward threshold, rllib/tuned_examples/)."""
+
+import numpy as np
+import pytest
+
+
+def test_cartpole_dynamics():
+    from ray_tpu.rl import CartPoleEnv
+
+    env = CartPoleEnv(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    steps = 0
+    terminated = False
+    while not terminated and steps < 600:
+        obs, reward, terminated, truncated, _ = env.step(steps % 2)
+        total += reward
+        steps += 1
+        if truncated:
+            break
+    # Alternating actions balance poorly: episode ends well before cap.
+    assert terminated
+    assert 5 <= steps < 200
+
+
+def test_env_runner_batch_shapes(rt_session):
+    import jax
+
+    from ray_tpu.rl import EnvRunnerGroup
+    from ray_tpu.rl.models import init_policy_params
+
+    group = EnvRunnerGroup(
+        "CartPole-v1",
+        num_env_runners=2,
+        num_envs_per_runner=4,
+        rollout_length=16,
+    )
+    try:
+        params = init_policy_params(jax.random.PRNGKey(0), 4, 2)
+        group.sync_weights(params)
+        batch = group.sample()
+        n = 2 * 4 * 16
+        assert batch["obs"].shape == (n, 4)
+        assert batch["actions"].shape == (n,)
+        assert batch["advantages"].shape == (n,)
+        assert batch["value_targets"].shape == (n,)
+        assert np.isfinite(batch["advantages"]).all()
+    finally:
+        group.shutdown()
+
+
+@pytest.mark.slow
+def test_ppo_learns_cartpole(rt_session):
+    """Learning regression: PPO must clear a return threshold
+    (reference: rllib tuned_examples pass/fail on reward). Defaults
+    reach ~100 mean return within ~15 iterations (measured: 19 -> 133
+    over 25 iters)."""
+    from ray_tpu.rl import PPOConfig
+
+    algo = PPOConfig().environment("CartPole-v1").debugging(seed=0).build()
+    try:
+        best = 0.0
+        for _ in range(25):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 100.0:
+                break
+        assert best >= 100.0, f"PPO plateaued at {best}"
+    finally:
+        algo.stop()
+
+
+def test_ppo_save_restore(rt_session, tmp_path):
+    from ray_tpu.rl import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .build()
+    )
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+    finally:
+        algo.stop()
+
+    algo2 = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4)
+        .build()
+    )
+    try:
+        algo2.restore(path)
+        assert algo2.iteration == 1
+        result = algo2.train()
+        assert result["training_iteration"] == 2
+    finally:
+        algo2.stop()
