@@ -1,0 +1,231 @@
+"""Durable workflows (reference: python/ray/workflow).
+
+workflow.run(dag) executes a task DAG with every step's result
+persisted to storage before the workflow advances (reference:
+workflow/api.py:123 run, workflow_executor.py:32, workflow_storage.py).
+A crashed or failed workflow resumes from storage: finished steps are
+loaded, only missing/failed steps re-execute. Step identity is the
+node's position in the deterministic topological order plus its
+function name — stable across resubmissions of the same DAG shape.
+
+Scope note: static DAG workflows + per-step retries + resume are
+implemented; dynamic continuations (steps returning new DAGs) and
+virtual actors are out of scope this round and documented as gaps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from ..dag.dag_node import DAGNode, FunctionNode, InputNode
+
+_DEFAULT_ROOT = os.path.join(
+    tempfile.gettempdir(), "rt_workflows"
+)
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESSFUL = "SUCCESSFUL"
+STATUS_FAILED = "FAILED"
+
+
+def _root(storage: Optional[str]) -> str:
+    root = storage or os.environ.get("RT_WORKFLOW_STORAGE", _DEFAULT_ROOT)
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+class _WorkflowStorage:
+    """(reference: workflow/workflow_storage.py — step results +
+    workflow metadata under a per-workflow prefix)."""
+
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self._meta_path = os.path.join(self.dir, "meta.json")
+
+    def save_meta(self, meta: dict) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path)
+
+    def load_meta(self) -> Optional[dict]:
+        try:
+            with open(self._meta_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, f"step-{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_dag(self, dag: DAGNode, input_value: Any) -> None:
+        import cloudpickle
+
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump({"dag": dag, "input": input_value}, f)
+
+    def load_dag(self):
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            state = pickle.load(f)
+        return state["dag"], state["input"]
+
+
+def _step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic ids keyed by node identity."""
+    ids: Dict[int, str] = {}
+    for index, node in enumerate(dag.topological_order()):
+        if isinstance(node, FunctionNode):
+            name = node._rf.underlying.__name__
+        else:
+            name = type(node).__name__.lower()
+        ids[id(node)] = f"{index:03d}-{name}"
+    return ids
+
+
+def _execute(
+    dag: DAGNode,
+    input_value: Any,
+    storage: _WorkflowStorage,
+) -> Any:
+    """Walk the DAG; each step's result is durable before dependents
+    run (reference: workflow_executor commit-before-advance)."""
+    import ray_tpu as rt
+
+    ids = _step_ids(dag)
+    cache: Dict[int, Any] = {}
+    for node in dag.topological_order():
+        step_id = ids[id(node)]
+        if isinstance(node, InputNode):
+            cache[id(node)] = input_value
+            continue
+        if storage.has_step(step_id):
+            cache[id(node)] = storage.load_step(step_id)
+            continue
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"workflows support task nodes only, got "
+                f"{type(node).__name__}"
+            )
+        args = [
+            cache[id(a)] if isinstance(a, DAGNode) else a
+            for a in node._bound_args
+        ]
+        kwargs = {
+            k: cache[id(v)] if isinstance(v, DAGNode) else v
+            for k, v in node._bound_kwargs.items()
+        }
+        ref = node._rf.remote(*args, **kwargs)
+        value = rt.get(ref, timeout=600)
+        storage.save_step(step_id, value)
+        cache[id(node)] = value
+    return cache[id(dag)]
+
+
+def run(
+    dag: DAGNode,
+    *,
+    workflow_id: Optional[str] = None,
+    input_value: Any = None,
+    storage: Optional[str] = None,
+) -> Any:
+    """Execute (or continue) a workflow to completion and return the
+    final result (reference: workflow.run, api.py:123)."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    store = _WorkflowStorage(_root(storage), workflow_id)
+    store.save_dag(dag, input_value)
+    store.save_meta(
+        {"workflow_id": workflow_id, "status": STATUS_RUNNING}
+    )
+    try:
+        result = _execute(dag, input_value, store)
+    except BaseException as e:
+        store.save_meta(
+            {
+                "workflow_id": workflow_id,
+                "status": STATUS_FAILED,
+                "error": repr(e),
+            }
+        )
+        raise
+    store.save_step("__output__", result)
+    store.save_meta(
+        {"workflow_id": workflow_id, "status": STATUS_SUCCESSFUL}
+    )
+    return result
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-drive an interrupted/failed workflow; completed steps load
+    from storage (reference: workflow.resume)."""
+    store = _WorkflowStorage(_root(storage), workflow_id)
+    meta = store.load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if meta["status"] == STATUS_SUCCESSFUL:
+        return store.load_step("__output__")
+    dag, input_value = store.load_dag()
+    return run(
+        dag,
+        workflow_id=workflow_id,
+        input_value=input_value,
+        storage=storage,
+    )
+
+
+def get_status(
+    workflow_id: str, *, storage: Optional[str] = None
+) -> Optional[str]:
+    meta = _WorkflowStorage(_root(storage), workflow_id).load_meta()
+    return meta["status"] if meta else None
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    store = _WorkflowStorage(_root(storage), workflow_id)
+    meta = store.load_meta()
+    if meta is None or meta["status"] != STATUS_SUCCESSFUL:
+        raise ValueError(
+            f"workflow {workflow_id!r} has no output "
+            f"(status={meta and meta['status']})"
+        )
+    return store.load_step("__output__")
+
+
+def list_all(*, storage: Optional[str] = None) -> List[dict]:
+    root = _root(storage)
+    out = []
+    for entry in sorted(os.listdir(root)):
+        meta = _WorkflowStorage(root, entry).load_meta()
+        if meta:
+            out.append(meta)
+    return out
+
+
+__all__ = [
+    "run",
+    "resume",
+    "get_status",
+    "get_output",
+    "list_all",
+    "STATUS_RUNNING",
+    "STATUS_SUCCESSFUL",
+    "STATUS_FAILED",
+]

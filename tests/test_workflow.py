@@ -1,0 +1,87 @@
+"""Workflow tests (reference test model: python/ray/workflow/tests/ —
+durable step results, failure + resume without re-executing finished
+steps)."""
+
+import os
+
+import pytest
+
+
+def test_workflow_runs_dag(rt_session, tmp_path):
+    rt = rt_session
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def double(x):
+        return 2 * x
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(inp))
+    result = workflow.run(
+        dag,
+        workflow_id="wf1",
+        input_value=5,
+        storage=str(tmp_path),
+    )
+    assert result == 20
+    assert workflow.get_status("wf1", storage=str(tmp_path)) == (
+        workflow.STATUS_SUCCESSFUL
+    )
+    assert workflow.get_output("wf1", storage=str(tmp_path)) == 20
+    assert [m["workflow_id"] for m in workflow.list_all(
+        storage=str(tmp_path)
+    )] == ["wf1"]
+
+
+def test_workflow_failure_and_resume(rt_session, tmp_path):
+    """Steps completed before a failure are NOT re-executed on resume
+    (reference: workflow storage skip-if-done)."""
+    rt = rt_session
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    marker = str(tmp_path / "executions")
+    flag = str(tmp_path / "fail.flag")
+    open(flag, "w").close()
+
+    @rt.remote
+    def counted(x):
+        with open(marker, "a") as f:
+            f.write("A")
+        return x + 1
+
+    @rt.remote
+    def flaky(x):
+        if os.path.exists(flag):
+            raise RuntimeError("transient failure")
+        return x * 100
+
+    with InputNode() as inp:
+        dag = flaky.bind(counted.bind(inp))
+
+    with pytest.raises(Exception, match="transient"):
+        workflow.run(
+            dag,
+            workflow_id="wf2",
+            input_value=1,
+            storage=str(tmp_path),
+        )
+    assert workflow.get_status("wf2", storage=str(tmp_path)) == (
+        workflow.STATUS_FAILED
+    )
+    assert open(marker).read() == "A"  # first step ran once
+
+    os.remove(flag)
+    result = workflow.resume("wf2", storage=str(tmp_path))
+    assert result == 200
+    assert open(marker).read() == "A"  # still once: loaded from storage
+    assert workflow.get_status("wf2", storage=str(tmp_path)) == (
+        workflow.STATUS_SUCCESSFUL
+    )
+    # Resuming a finished workflow returns the stored output.
+    assert workflow.resume("wf2", storage=str(tmp_path)) == 200
