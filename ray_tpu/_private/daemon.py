@@ -229,6 +229,7 @@ class NodeDaemon:
             "list_task_events",
             "list_nodes",
             "list_actors",
+            "list_objects",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -2330,6 +2331,29 @@ class NodeDaemon:
                     for rt in self.actor_runtimes.values()
                 ]
             }
+
+    def _h_list_objects(self, conn, msg):
+        """Node-local object table for the state API (reference:
+        node_manager.cc:780 HandleGetObjectsInfo)."""
+        limit = int(msg.get("limit", 1000))
+        with self._lock:
+            entries = list(self.objects.items())[:limit]
+            out = []
+            for oid, entry in entries:
+                out.append(
+                    {
+                        "object_id": oid.hex(),
+                        "state": entry.state,
+                        "size": entry.size,
+                        "in_shm": entry.in_shm,
+                        "inline": entry.inline is not None,
+                        "locations": [
+                            NodeID(n).hex() for n in entry.locations
+                        ],
+                        "ref_count": entry.refcount,
+                    }
+                )
+        return {"objects": out}
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:

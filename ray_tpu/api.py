@@ -37,6 +37,12 @@ def init(
             "ray_tpu.init() already called; pass ignore_reinit_error=True "
             "or call shutdown() first."
         )
+    import os as _os
+
+    if address is None:
+        # Jobs submitted to a running cluster connect via the address
+        # the job manager injected (reference: RAY_ADDRESS).
+        address = _os.environ.get("RT_ADDRESS") or None
     _session = Session(
         num_cpus=num_cpus,
         num_tpus=num_tpus,

@@ -1,0 +1,273 @@
+"""Cluster CLI.
+
+Reference: python/ray/scripts/scripts.py — `ray start --head` /
+`ray start --address=...` bring nodes up (:644), `ray stop`, `ray
+status`, `ray job submit`, and the `ray list ...` state commands
+(util/state/state_cli.py). Invoked as `python -m ray_tpu <cmd>`.
+
+The head daemon runs in the foreground of the `start` process (use
+`&`, systemd, or a supervisor to daemonize); its address + pid land in
+a cluster-info file (default /tmp/rt_cluster_info.json) that the other
+commands read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+DEFAULT_INFO_PATH = "/tmp/rt_cluster_info.json"
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    env = os.environ.get("RT_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(args.cluster_info) as f:
+            return json.load(f)["address"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        sys.exit(
+            "no cluster found: pass --address, set RT_ADDRESS, or "
+            f"start one with `python -m ray_tpu start --head` "
+            f"(looked in {args.cluster_info})"
+        )
+
+
+def cmd_start(args) -> None:
+    import tempfile
+
+    from .._private.accelerators import detect_accelerators
+    from .._private.config import Config
+    from .._private.daemon import NodeDaemon
+
+    config = Config.from_env(None)
+    resources = json.loads(args.resources) if args.resources else {}
+    resources.setdefault(
+        "CPU",
+        float(args.num_cpus if args.num_cpus is not None else os.cpu_count()),
+    )
+    detected, labels = detect_accelerators(
+        {"TPU": float(args.num_tpus)} if args.num_tpus is not None else None
+    )
+    for name, amount in detected.items():
+        resources.setdefault(name, amount)
+    resources.setdefault("memory", float(2**34))
+    session_dir = args.session_dir or tempfile.mkdtemp(prefix="rt_node_")
+    if args.head:
+        daemon = NodeDaemon(
+            session_dir, resources, config, is_head=True, labels=labels
+        )
+        daemon.start()
+        info = {
+            "address": daemon.socket_path,
+            "pid": os.getpid(),
+            "session_dir": session_dir,
+        }
+        with open(args.cluster_info, "w") as f:
+            json.dump(info, f)
+        print(f"head started: address={daemon.socket_path}")
+        print(
+            "connect with ray_tpu.init(address="
+            f"{daemon.socket_path!r}) or RT_ADDRESS={daemon.socket_path}"
+        )
+    else:
+        head_address = _resolve_address(args)
+        daemon = NodeDaemon(
+            session_dir,
+            resources,
+            config,
+            is_head=False,
+            head_address=head_address,
+            labels=labels,
+        )
+        daemon.start()
+        print(f"node started, joined head at {head_address}")
+
+    stop = {"flag": False}
+
+    def on_term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        daemon.shutdown()
+        if args.head:
+            try:
+                os.remove(args.cluster_info)
+            except OSError:
+                pass
+
+
+def cmd_stop(args) -> None:
+    try:
+        with open(args.cluster_info) as f:
+            info = json.load(f)
+    except OSError:
+        print("no running cluster found")
+        return
+    try:
+        os.kill(info["pid"], signal.SIGTERM)
+        print(f"sent SIGTERM to head (pid {info['pid']})")
+    except ProcessLookupError:
+        print("head process already gone")
+        try:
+            os.remove(args.cluster_info)
+        except OSError:
+            pass
+
+
+def _connect(args):
+    import ray_tpu as rt
+
+    rt.init(address=_resolve_address(args))
+    return rt
+
+
+def cmd_status(args) -> None:
+    rt = _connect(args)
+    nodes = rt.nodes()
+    print(f"nodes: {len(nodes)}")
+    for node in nodes:
+        mark = " (head)" if node.get("is_head") else ""
+        print(
+            f"  {node['node_id'][:12]}{mark} alive={node['alive']} "
+            f"resources={node['resources']}"
+        )
+    print("cluster totals:", rt.cluster_resources())
+    print("available:    ", rt.available_resources())
+
+
+def cmd_summary(args) -> None:
+    rt = _connect(args)
+    print(json.dumps(rt.state_summary(), indent=2, default=str))
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ..util import state
+
+    kind = args.kind
+    rows = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_submit(args) -> None:
+    from ..job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    import shlex
+
+    entrypoint = list(args.entrypoint)
+    if entrypoint and entrypoint[0] == "--":
+        entrypoint = entrypoint[1:]
+    if not entrypoint:
+        sys.exit("submit: missing entrypoint command")
+    job_id = client.submit_job(
+        entrypoint=" ".join(shlex.quote(t) for t in entrypoint),
+        runtime_env=runtime_env or None,
+    )
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finished(job_id, timeout=args.timeout)
+    print(f"status: {status.value}")
+    logs = client.get_job_logs(job_id)
+    if logs:
+        print("--- logs ---")
+        print(logs, end="")
+    if status != JobStatus.SUCCEEDED:
+        sys.exit(1)
+
+
+def cmd_jobs(args) -> None:
+    from ..job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="TPU-native distributed runtime CLI"
+    )
+    parser.add_argument(
+        "--cluster-info",
+        default=DEFAULT_INFO_PATH,
+        help="path of the cluster-info file (head address + pid)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start a head or worker node")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", help="head address to join")
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--num-tpus", type=float, default=None)
+    p_start.add_argument(
+        "--resources", help='extra resources as JSON, e.g. \'{"A": 2}\''
+    )
+    p_start.add_argument("--session-dir")
+    p_start.set_defaults(fn=cmd_start)
+
+    p_stop = sub.add_parser("stop", help="stop the head node")
+    p_stop.set_defaults(fn=cmd_stop)
+
+    for name, fn in (
+        ("status", cmd_status),
+        ("summary", cmd_summary),
+    ):
+        p = sub.add_parser(name)
+        p.add_argument("--address")
+        p.set_defaults(fn=fn)
+
+    p_list = sub.add_parser("list", help="state API listings")
+    p_list.add_argument(
+        "kind",
+        choices=[
+            "nodes",
+            "actors",
+            "tasks",
+            "objects",
+            "placement-groups",
+        ],
+    )
+    p_list.add_argument("--address")
+    p_list.set_defaults(fn=cmd_list)
+
+    p_submit = sub.add_parser("submit", help="submit a job")
+    p_submit.add_argument("--address")
+    p_submit.add_argument("--working-dir")
+    p_submit.add_argument("--no-wait", action="store_true")
+    p_submit.add_argument("--timeout", type=float, default=600.0)
+    p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p_submit.set_defaults(fn=cmd_submit)
+
+    p_jobs = sub.add_parser("jobs", help="list submitted jobs")
+    p_jobs.add_argument("--address")
+    p_jobs.set_defaults(fn=cmd_jobs)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

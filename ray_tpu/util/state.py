@@ -1,0 +1,61 @@
+"""State API: programmatic cluster introspection.
+
+Reference: python/ray/util/state/api.py:110 — list_nodes/actors/tasks/
+objects/placement_groups aggregated from the control plane; the CLI
+(`ray list ...`, util/state/state_cli.py) prints the same tables.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import exceptions as exc
+
+
+def _worker():
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    if worker is None:
+        raise exc.RayTpuError("ray_tpu.init() has not been called")
+    return worker
+
+
+def list_nodes() -> List[dict]:
+    return _worker().call("list_nodes")["nodes"]
+
+
+def list_actors() -> List[dict]:
+    return _worker().call("list_actors")["actors"]
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    events = _worker().call("list_task_events")["events"]
+    # Collapse the event stream into latest-state-per-task (reference:
+    # GcsTaskManager keeps per-task state transitions).
+    latest = {}
+    for event in events:
+        latest[event["task_id"]] = event
+    return list(latest.values())[:limit]
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    return _worker().call("list_objects", limit=limit)["objects"]
+
+
+def list_placement_groups() -> List[dict]:
+    return _worker().call("placement_group_table")["table"]
+
+
+def summarize() -> dict:
+    return _worker().call("state_summary")["summary"]
+
+
+__all__ = [
+    "list_nodes",
+    "list_actors",
+    "list_tasks",
+    "list_objects",
+    "list_placement_groups",
+    "summarize",
+]

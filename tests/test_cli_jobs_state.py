@@ -1,0 +1,169 @@
+"""CLI / job submission / state API tests (reference test models:
+python/ray/tests/test_cli.py, dashboard/modules/job/tests,
+python/ray/tests/test_state_api.py)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_state_api_lists(rt_session):
+    rt = rt_session
+    from ray_tpu.util import state
+
+    @rt.remote
+    def f():
+        return 1
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    ref = rt.put(list(range(100)))
+    rt.get(f.remote(), timeout=20)
+    a = A.remote()
+    rt.get(a.ping.remote(), timeout=20)
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    actors = state.list_actors()
+    assert any(x["class_name"] == "A" for x in actors)
+    tasks = state.list_tasks()
+    assert any(t["name"] == "f" for t in tasks)
+    objects = state.list_objects()
+    assert len(objects) >= 1
+    assert state.summarize()
+
+
+def test_job_submission_end_to_end(rt_session, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import ray_tpu as rt\n"
+        "rt.init()\n"  # picks up RT_ADDRESS from the job env
+        "@rt.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('job result:', rt.get(f.remote(14)))\n"
+    )
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        metadata={"who": "test"},
+    )
+    status = client.wait_until_finished(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job result: 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(rt_session):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'"
+    )
+    assert client.wait_until_finished(job_id, 60) == JobStatus.FAILED
+    assert client.get_job_info(job_id)["exit_code"] == 3
+
+
+@pytest.mark.slow
+def test_cli_start_status_submit_stop(tmp_path):
+    """Full CLI lifecycle against a real head process."""
+    info = str(tmp_path / "cluster.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+    head = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu",
+            "--cluster-info",
+            info,
+            "start",
+            "--head",
+            "--num-cpus",
+            "2",
+            "--num-tpus",
+            "0",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(info):
+            time.sleep(0.2)
+        assert os.path.exists(info), "head never wrote cluster info"
+
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "--cluster-info",
+                info,
+                "status",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "nodes: 1" in out.stdout
+
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "--cluster-info",
+                info,
+                "submit",
+                "--",
+                sys.executable,
+                "-c",
+                "print(6*7)",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "42" in out.stdout
+
+        subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "--cluster-info",
+                info,
+                "stop",
+            ],
+            env=env,
+            capture_output=True,
+            timeout=60,
+        )
+        assert head.wait(timeout=30) is not None
+    finally:
+        if head.poll() is None:
+            head.send_signal(signal.SIGKILL)
