@@ -230,6 +230,7 @@ class NodeDaemon:
             "list_nodes",
             "list_actors",
             "list_objects",
+            "cluster_load",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -2354,6 +2355,49 @@ class NodeDaemon:
                     }
                 )
         return {"objects": out}
+
+    def _h_cluster_load(self, conn, msg):
+        """Pending demand + per-node utilization for the autoscaler
+        (reference: GcsAutoscalerStateManager serving cluster resource
+        state / pending demand via autoscaler.proto)."""
+        if not self.is_head:
+            return self.head.call("cluster_load")
+        with self._lock:
+            infeasible = [
+                dict(spec.get("resources") or {})
+                for spec in self._infeasible.values()
+            ]
+            pending_pgs = [
+                {"strategy": e.strategy, "bundles": list(e.bundles)}
+                for e in self.pgs.values()
+                if e.state in ("PENDING", "RESCHEDULING")
+            ]
+        nodes = []
+        mine = self.node_id.binary()
+        for info in self.control.alive_nodes():
+            nid = info.node_id.binary()
+            if nid == mine:
+                available = self.scheduler.available().to_dict()
+                total = self.scheduler.total().to_dict()
+                queued = self.scheduler.queued_count()
+            else:
+                available = dict(info.available)
+                total = dict(info.resources)
+                queued = info.queued
+            nodes.append(
+                {
+                    "node_id": info.node_id.hex(),
+                    "is_head": info.is_head,
+                    "total": total,
+                    "available": available,
+                    "queued": queued,
+                }
+            )
+        return {
+            "infeasible": infeasible,
+            "pending_placement_groups": pending_pgs,
+            "nodes": nodes,
+        }
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:

@@ -1,0 +1,14 @@
+"""Autoscaling (reference: python/ray/autoscaler)."""
+
+from .autoscaler import Monitor, NodeTypeConfig, StandardAutoscaler
+from .cluster import AutoscalingCluster
+from .node_provider import FakeMultiNodeProvider, NodeProvider
+
+__all__ = [
+    "StandardAutoscaler",
+    "Monitor",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "AutoscalingCluster",
+]

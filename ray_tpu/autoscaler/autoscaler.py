@@ -1,0 +1,201 @@
+"""Demand-driven autoscaler.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update reconcile loop) + resource_demand_scheduler
+.py (bin-pack pending demand into node types) + monitor.py (the
+polling daemon); v2 reads the same demand from
+GcsAutoscalerStateManager — which is what our `cluster_load` head RPC
+mirrors.
+
+Loop: read demand (infeasible tasks + pending placement-group
+bundles) -> bin-pack what doesn't fit on live/launching nodes into the
+cheapest satisfying node types (bounded by max_workers) -> launch;
+terminate workers idle past idle_timeout (respecting min_workers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .node_provider import NodeProvider
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def _fits(request: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(
+        capacity.get(name, 0.0) >= amount
+        for name, amount in request.items()
+    )
+
+
+def _consume(capacity: Dict[str, float], request: Dict[str, float]):
+    for name, amount in request.items():
+        capacity[name] = capacity.get(name, 0.0) - amount
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        provider: NodeProvider,
+        node_types: Dict[str, NodeTypeConfig],
+        *,
+        idle_timeout_s: float = 5.0,
+        upscaling_speed: float = 1.0,
+    ):
+        self.provider = provider
+        self.node_types = node_types
+        self.idle_timeout_s = idle_timeout_s
+        self.upscaling_speed = upscaling_speed
+        self._last_busy: Dict[str, float] = {}
+        self._client = None
+        self._launched_types: Dict[str, int] = {}
+
+    # -- demand --------------------------------------------------------
+    def _load(self) -> dict:
+        from .._private.rpc import RpcClient
+
+        if self._client is None:
+            self._client = RpcClient(self.provider.head_address)
+        return self._client.call("cluster_load")
+
+    # -- one reconcile pass (reference: StandardAutoscaler.update) ----
+    def update(self) -> dict:
+        load = self._load()
+        demand: List[Dict[str, float]] = list(load["infeasible"])
+        for pg in load["pending_placement_groups"]:
+            demand.extend(pg["bundles"])
+
+        # Capacity view: live worker availability + launching nodes.
+        live_available = [
+            dict(node["available"])
+            for node in load["nodes"]
+        ]
+        launching: List[Dict[str, float]] = []
+        provider_nodes = self.provider.non_terminated_nodes()
+        live_ids = {n["node_id"] for n in load["nodes"]}
+        for p in provider_nodes:
+            if self.provider.cluster_node_id(p) not in live_ids:
+                node_type = self.provider.node_type(p)
+                if node_type in self.node_types:
+                    launching.append(
+                        dict(self.node_types[node_type].resources)
+                    )
+
+        # min_workers floor.
+        to_launch: Dict[str, int] = {}
+        counts: Dict[str, int] = {}
+        for p in provider_nodes:
+            node_type = self.provider.node_type(p)
+            counts[node_type] = counts.get(node_type, 0) + 1
+        for name, cfg in self.node_types.items():
+            if counts.get(name, 0) < cfg.min_workers:
+                to_launch[name] = cfg.min_workers - counts.get(name, 0)
+
+        # Bin-pack unmet demand (reference: resource_demand_scheduler).
+        pool = live_available + launching
+        for request in demand:
+            if not request:
+                continue
+            placed = False
+            for capacity in pool:
+                if _fits(request, capacity):
+                    _consume(capacity, request)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for name, cfg in sorted(self.node_types.items()):
+                total = counts.get(name, 0) + to_launch.get(name, 0)
+                if total >= cfg.max_workers:
+                    continue
+                if _fits(request, cfg.resources):
+                    to_launch[name] = to_launch.get(name, 0) + 1
+                    fresh = dict(cfg.resources)
+                    _consume(fresh, request)
+                    pool.append(fresh)
+                    placed = True
+                    break
+            # Unplaceable anywhere: reported, not fatal.
+
+        launched = []
+        for name, count in to_launch.items():
+            cfg = self.node_types[name]
+            for _ in range(count):
+                launched.append(
+                    self.provider.create_node(
+                        name, cfg.resources, cfg.labels
+                    )
+                )
+
+        # Scale down idle workers (reference: idle node termination).
+        terminated = []
+        now = time.time()
+        cluster_by_id = {n["node_id"]: n for n in load["nodes"]}
+        for p in list(provider_nodes):
+            cluster_id = self.provider.cluster_node_id(p)
+            node = cluster_by_id.get(cluster_id)
+            if node is None:
+                continue  # still launching
+            busy = node["queued"] > 0 or any(
+                node["available"].get(k, 0.0) != v
+                for k, v in node["total"].items()
+            )
+            if busy:
+                self._last_busy[p] = now
+                continue
+            idle_for = now - self._last_busy.setdefault(p, now)
+            node_type = self.provider.node_type(p)
+            cfg = self.node_types.get(node_type)
+            type_count = counts.get(node_type, 0)
+            if (
+                cfg is not None
+                and idle_for >= self.idle_timeout_s
+                and type_count > cfg.min_workers
+            ):
+                self.provider.terminate_node(p)
+                counts[node_type] = type_count - 1
+                terminated.append(p)
+        return {
+            "demand": len(demand),
+            "launched": launched,
+            "terminated": terminated,
+        }
+
+
+class Monitor:
+    """Background reconcile loop (reference: _private/monitor.py)."""
+
+    def __init__(
+        self, autoscaler: StandardAutoscaler, interval_s: float = 0.5
+    ):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

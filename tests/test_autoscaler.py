@@ -1,0 +1,109 @@
+"""Autoscaler tests (reference test model: autoscaler tests with
+FakeMultiNodeProvider + AutoscalingCluster — scale up on demand, honor
+min/max, scale down when idle)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def scaling_cluster():
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        worker_node_types={
+            "cpu-worker": {
+                "resources": {"CPU": 2.0, "memory": float(2**30)},
+                "min_workers": 0,
+                "max_workers": 3,
+            },
+        },
+        idle_timeout_s=2.0,
+    )
+    cluster.start()
+    rt.init(address=cluster.address)
+    yield rt, cluster
+    rt.shutdown()
+    cluster.shutdown()
+
+
+def test_scales_up_for_infeasible_task_then_down(scaling_cluster):
+    rt, cluster = scaling_cluster
+    assert cluster.num_workers() == 0
+
+    # Needs 2 CPUs; the 1-CPU head can't run it.
+    @rt.remote(num_cpus=2)
+    def heavy():
+        return "ran"
+
+    ref = heavy.remote()
+    assert rt.get(ref, timeout=60) == "ran"
+    assert cluster.num_workers() >= 1
+
+    # Idle workers terminate after idle_timeout (min_workers=0).
+    deadline = time.time() + 30
+    while time.time() < deadline and cluster.num_workers() > 0:
+        time.sleep(0.3)
+    assert cluster.num_workers() == 0
+
+
+def test_scales_up_for_placement_group(scaling_cluster):
+    rt, cluster = scaling_cluster
+    from ray_tpu.util import placement_group
+
+    pg = placement_group(
+        [{"CPU": 2.0}, {"CPU": 2.0}], strategy="STRICT_SPREAD"
+    )
+    assert pg.wait(60)
+    assert cluster.num_workers() >= 2
+
+
+def test_respects_max_workers(scaling_cluster):
+    rt, cluster = scaling_cluster
+
+    @rt.remote(num_cpus=2)
+    def hold():
+        import time as _t
+
+        _t.sleep(3)
+        return 1
+
+    refs = [hold.remote() for _ in range(10)]
+    deadline = time.time() + 20
+    peak = 0
+    while time.time() < deadline:
+        peak = max(peak, cluster.num_workers())
+        time.sleep(0.2)
+        if peak >= 3:
+            break
+    assert peak <= 3
+    rt.get(refs, timeout=120)
+
+
+def test_min_workers_floor():
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        worker_node_types={
+            "base": {
+                "resources": {"CPU": 1.0, "memory": float(2**30)},
+                "min_workers": 2,
+                "max_workers": 4,
+            },
+        },
+    )
+    cluster.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and cluster.num_workers() < 2:
+            time.sleep(0.2)
+        assert cluster.num_workers() >= 2
+        rt.init(address=cluster.address)
+        rt.shutdown()
+    finally:
+        cluster.shutdown()
