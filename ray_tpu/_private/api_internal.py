@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional, Sequence
 from .. import exceptions as exc
 from ..actor import ActorClass, ActorHandle
 from ..remote_function import RemoteFunction
+from .runtime_env import prepare_runtime_env
 from .worker import CoreWorker, global_worker
 
 
@@ -114,6 +115,7 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
         max_retries=opts.get("max_retries", worker.config.task_max_retries),
         scheduling_strategy=strategy,
         pg_context=pg_context,
+        runtime_env=prepare_runtime_env(opts.get("runtime_env"), worker),
     )
     return refs[0] if num_returns == 1 else refs
 
@@ -141,6 +143,9 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         handle_meta=meta,
         scheduling_strategy=strategy,
         pg_context=pg_context,
+        runtime_env=prepare_runtime_env(
+            opts.get("runtime_env"), worker
+        ),
     )
     return ActorHandle(actor_id, meta)
 

@@ -1,0 +1,182 @@
+"""Runtime environments: per-task/actor execution context.
+
+Reference: python/ray/_private/runtime_env/ — env_vars, working_dir,
+py_modules (plugin.py's RuntimeEnvPlugin registry; working_dir.py
+packages the directory and workers download+cache it by content hash).
+Here packaging rides the cluster KV store (the reference uses GCS
+packages the same way): the driver zips working_dir/py_modules into
+KV under a content hash, workers extract once into a node-local cache
+and prepend to sys.path. env_vars apply around task execution and are
+restored afterwards (shared workers); actors keep their env for life
+(they pin their worker).
+
+`pip`/`conda`/`uv` fields raise RuntimeEnvSetupError: the deployment
+environment is hermetic (no package installs at runtime); images are
+the supported isolation unit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+from .. import exceptions as exc
+
+_MAX_PACKAGE_BYTES = 256 * 1024 * 1024
+_CACHE_ROOT = "/tmp/rt_runtime_env_cache"
+
+# Extension point (reference: runtime_env/plugin.py): name -> callable
+# (value, context_dict) -> None, run worker-side inside apply.
+PLUGINS: Dict[str, Any] = {}
+
+_KNOWN_FIELDS = {
+    "env_vars",
+    "working_dir",
+    "py_modules",
+    "pip",
+    "conda",
+    "uv",
+}
+
+
+def _zip_dir(path: str, prefix: str = "") -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _, files in os.walk(path):
+            for name in files:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                if rel.startswith(".git" + os.sep):
+                    continue
+                zf.write(
+                    full, os.path.join(prefix, rel) if prefix else rel
+                )
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise exc.RuntimeEnvSetupError(
+            f"packaged dir {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES})"
+        )
+    return data
+
+
+def prepare_runtime_env(
+    env: Optional[dict], worker
+) -> Optional[dict]:
+    """Driver-side: validate + package + upload; returns the wire form
+    embedded in the task spec."""
+    if not env:
+        return None
+    unknown = set(env) - _KNOWN_FIELDS - set(PLUGINS)
+    if unknown:
+        raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+    for banned in ("pip", "conda", "uv"):
+        if env.get(banned):
+            raise exc.RuntimeEnvSetupError(
+                f"runtime_env[{banned!r}] is unsupported: runtime "
+                "package installation is disabled in this environment; "
+                "bake dependencies into the image instead"
+            )
+    wire: Dict[str, Any] = {}
+    if env.get("env_vars"):
+        wire["env_vars"] = {
+            str(k): str(v) for k, v in env["env_vars"].items()
+        }
+    if env.get("working_dir"):
+        wire["working_dir"] = _upload_dir(env["working_dir"], worker)
+    if env.get("py_modules"):
+        # Each module dir is zipped under its own name so the extracted
+        # cache dir is the importable parent on sys.path.
+        wire["py_modules"] = [
+            _upload_dir(m, worker, nest_under_name=True)
+            for m in env["py_modules"]
+        ]
+    for name in PLUGINS:
+        if name in env:
+            wire[name] = env[name]
+    return wire
+
+
+def _upload_dir(path: str, worker, nest_under_name: bool = False) -> dict:
+    if not os.path.isdir(path):
+        raise exc.RuntimeEnvSetupError(
+            f"runtime_env dir {path!r} does not exist"
+        )
+    data = _zip_dir(
+        path, prefix=os.path.basename(path.rstrip(os.sep))
+        if nest_under_name
+        else "",
+    )
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    key = f"__rt_pkg__{digest}"
+    # Upload once per content hash (KV is the package store).
+    if worker.call("kv_get", key=key).get("value") is None:
+        worker.call("kv_put", key=key, value=data)
+    return {"key": key, "hash": digest, "name": os.path.basename(path)}
+
+
+def _fetch_package(pkg: dict, worker) -> str:
+    """Worker-side: download + extract once per content hash."""
+    target = os.path.join(_CACHE_ROOT, pkg["hash"])
+    if os.path.isdir(target):
+        return target
+    reply = worker.call("kv_get", key=pkg["key"])
+    if reply.get("value") is None:
+        raise exc.RuntimeEnvSetupError(
+            f"package {pkg['key']} missing from cluster KV"
+        )
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    tmp = target + f".tmp{os.getpid()}"
+    with zipfile.ZipFile(io.BytesIO(reply["value"])) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # Another worker won the race; its copy is identical.
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+@contextmanager
+def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
+    """Worker-side: enter the env around task execution. restore=False
+    for actors (they own their worker for life)."""
+    if not wire:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_path = list(sys.path)
+    saved_cwd = os.getcwd()
+    try:
+        for key, value in (wire.get("env_vars") or {}).items():
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = value
+        if wire.get("working_dir"):
+            workdir = _fetch_package(wire["working_dir"], worker)
+            os.chdir(workdir)
+            sys.path.insert(0, workdir)
+        for pkg in wire.get("py_modules") or []:
+            sys.path.insert(0, _fetch_package(pkg, worker))
+        for name, hook in PLUGINS.items():
+            if name in wire:
+                hook(wire[name], {"worker": worker})
+        yield
+    finally:
+        if restore:
+            for key, value in saved_env.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
+            sys.path[:] = saved_path
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass

@@ -279,6 +279,7 @@ class CoreWorker:
         max_retries: int = 0,
         scheduling_strategy: Optional[dict] = None,
         pg_context: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
         returns = [
@@ -296,6 +297,7 @@ class CoreWorker:
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
+            "runtime_env": runtime_env,
         }
         self._client.call("submit_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
@@ -312,6 +314,7 @@ class CoreWorker:
         handle_meta: Optional[dict] = None,
         scheduling_strategy: Optional[dict] = None,
         pg_context: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
@@ -331,6 +334,7 @@ class CoreWorker:
             "handle_meta": handle_meta,
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
+            "runtime_env": runtime_env,
         }
         self._client.call("create_actor", spec=spec)
         return actor_id
@@ -408,33 +412,49 @@ class CoreWorker:
         )
         self.job_id = JobID(spec["job_id"])
         try:
+            from .runtime_env import apply_runtime_env
+
             args, kwargs = _split_kwargs(self._deserialize_args(spec["args"]))
             kind = spec["kind"]
-            if kind == "actor_creation":
-                cls = self.functions.fetch(spec["function_key"])
-                self._actor_instance = cls(*args, **kwargs)
-                self._actor_id = ActorID(spec["actor_id"])
-                self._actor_pg_context = spec.get("pg_context")
-                results = [None]
-            elif kind == "actor_task":
-                if self._actor_instance is None:
-                    raise exc.ActorDiedError("actor instance missing")
-                if spec["method"] == "__rt_dag_loop__":
-                    # Compiled-DAG execution loop: the actor blocks on
-                    # its channels until torn down (dag/compiled.py).
-                    from ..dag.compiled import dag_exec_loop
+            # Actors keep their runtime env for life (they pin this
+            # worker); shared task workers restore afterwards.
+            with apply_runtime_env(
+                spec.get("runtime_env"),
+                self,
+                restore=(kind != "actor_creation"),
+            ):
+                if kind == "actor_creation":
+                    cls = self.functions.fetch(spec["function_key"])
+                    self._actor_instance = cls(*args, **kwargs)
+                    self._actor_id = ActorID(spec["actor_id"])
+                    self._actor_pg_context = spec.get("pg_context")
+                    results = [None]
+                elif kind == "actor_task":
+                    if self._actor_instance is None:
+                        raise exc.ActorDiedError("actor instance missing")
+                    if spec["method"] == "__rt_dag_loop__":
+                        # Compiled-DAG execution loop: the actor blocks
+                        # on its channels until torn down
+                        # (dag/compiled.py).
+                        from ..dag.compiled import dag_exec_loop
 
-                    value = dag_exec_loop(
-                        self._actor_instance, *args, **kwargs
+                        value = dag_exec_loop(
+                            self._actor_instance, *args, **kwargs
+                        )
+                    else:
+                        method = getattr(
+                            self._actor_instance, spec["method"]
+                        )
+                        value = method(*args, **kwargs)
+                    results = self._split_returns(
+                        value, len(spec["returns"])
                     )
                 else:
-                    method = getattr(self._actor_instance, spec["method"])
-                    value = method(*args, **kwargs)
-                results = self._split_returns(value, len(spec["returns"]))
-            else:
-                func = self.functions.fetch(spec["function_key"])
-                value = func(*args, **kwargs)
-                results = self._split_returns(value, len(spec["returns"]))
+                    func = self.functions.fetch(spec["function_key"])
+                    value = func(*args, **kwargs)
+                    results = self._split_returns(
+                        value, len(spec["returns"])
+                    )
         except BaseException as e:  # noqa: BLE001 — any task failure
             payload = make_exception_payload(e)
             self._client.notify(
