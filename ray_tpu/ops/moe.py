@@ -1,0 +1,173 @@
+"""Mixture-of-experts with expert parallelism.
+
+Absent from the reference (SURVEY.md §2.4 EP row — no MoE sharding
+anywhere in Ray core or its libraries); built TPU-first: experts shard
+over the `ep` mesh axis, token dispatch/return are `lax.all_to_all`
+hops over ICI, and the per-expert FFN is a dense batched matmul that
+lands on the MXU (GShard/Switch capacity-based dispatch — fixed
+capacity keeps every shape static for XLA; overflow tokens drop to the
+residual path, the standard trade).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_moe_params(
+    key,
+    num_experts: int,
+    d_model: int,
+    d_ff: int,
+    dtype=jnp.float32,
+) -> Dict[str, jax.Array]:
+    k_router, k1, k2 = jax.random.split(key, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "router": (
+            jax.random.normal(k_router, (d_model, num_experts)) * scale_in
+        ).astype(dtype),
+        "w_in": (
+            jax.random.normal(k1, (num_experts, d_model, d_ff)) * scale_in
+        ).astype(dtype),
+        "w_out": (
+            jax.random.normal(k2, (num_experts, d_ff, d_model)) * scale_out
+        ).astype(dtype),
+    }
+
+
+def top_k_router(
+    logits: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """[tokens, experts] -> (gates [t, k], indices [t, k], aux_loss).
+
+    aux_loss is the Switch/GShard load-balancing loss: mean expert
+    probability x mean assignment fraction, scaled by num_experts.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, indices = lax.top_k(probs, k)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    num_experts = logits.shape[-1]
+    assign = jnp.sum(
+        jax.nn.one_hot(indices[:, 0], num_experts), axis=0
+    ) / logits.shape[0]
+    importance = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(assign * importance)
+    return gates, indices, aux_loss
+
+
+def _dispatch_tensors(
+    indices: jax.Array,
+    gates: jax.Array,
+    num_experts: int,
+    capacity: int,
+):
+    """Capacity-based dispatch (Switch-style): per (token, choice),
+    its position in the target expert's buffer; tokens past capacity
+    drop. Returns dispatch one-hot [t, E, C] and combine [t, E, C]."""
+    t, k = indices.shape
+    flat_expert = indices.reshape(-1)  # [t*k], choice-major rows
+    onehot = jax.nn.one_hot(flat_expert, num_experts, dtype=jnp.int32)
+    # Position of each (token, choice) within its expert queue.
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1  # [t*k, E]
+    pos_in_expert = jnp.sum(position * onehot, axis=-1)  # [t*k]
+    keep = pos_in_expert < capacity
+    pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1)
+    dispatch = (
+        jax.nn.one_hot(flat_expert, num_experts)[:, :, None]
+        * jax.nn.one_hot(pos_clipped, capacity)[:, None, :]
+        * keep[:, None, None]
+    )  # [t*k, E, C]
+    dispatch = dispatch.reshape(t, k, num_experts, capacity).sum(axis=1)
+    combine = (
+        (
+            jax.nn.one_hot(flat_expert, num_experts)[:, :, None]
+            * jax.nn.one_hot(pos_clipped, capacity)[:, None, :]
+            * (keep * gates.reshape(-1))[:, None, None]
+        )
+        .reshape(t, k, num_experts, capacity)
+        .sum(axis=1)
+    )
+    return dispatch, combine
+
+
+def moe_ffn_dense(params: Dict, x: jax.Array, k: int = 2):
+    """Single-device reference: every expert local. x: [tokens, d]."""
+    logits = x @ params["router"]
+    gates, indices, aux = top_k_router(logits, k)
+    outs = jnp.einsum("td,edf->tef", x, params["w_in"])
+    outs = jax.nn.gelu(outs)
+    outs = jnp.einsum("tef,efd->ted", outs, params["w_out"])
+    picked = jnp.take_along_axis(
+        outs, indices[:, :, None], axis=1
+    )  # [t, k, d]
+    return (
+        jnp.sum(picked * gates[:, :, None].astype(x.dtype), axis=1),
+        aux,
+    )
+
+
+def moe_ffn_ep(
+    params: Dict,
+    x: jax.Array,
+    *,
+    axis_name: str = "ep",
+    k: int = 2,
+    capacity_factor: float = 2.0,
+):
+    """Expert-parallel MoE inside shard_map.
+
+    Each rank holds E_local = E/ep experts (params sharded on the
+    expert axis) and a token shard x: [t_local, d]. Dispatch:
+    one all_to_all sends each rank's per-expert buffers to the expert's
+    owner; experts run dense; a second all_to_all returns outputs.
+    """
+    ep = lax.axis_size(axis_name)
+    e_local = params["w_in"].shape[0]
+    num_experts = e_local * ep
+    t_local, d = x.shape
+    capacity = int(
+        math.ceil(k * t_local * capacity_factor / num_experts)
+    )
+    capacity = max(capacity, 1)
+
+    # The router is tiny ([d, E]) and replicated on every rank; only
+    # the expert FFN weights shard over ep.
+    logits = x @ params["router"]
+    gates, indices, aux = top_k_router(logits, k)
+    dispatch, combine = _dispatch_tensors(
+        indices, gates, num_experts, capacity
+    )
+    # Expert-major buffers: [E, C, d] = tokens this rank sends to each
+    # expert, then all_to_all regroups by owner rank.
+    expert_inputs = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(x.dtype), x
+    )  # [E, C, d]
+    # [E, C, d] -> [ep, E_local, C, d] -> a2a -> [ep, E_local, C, d]
+    # where now the leading axis indexes SOURCE rank.
+    expert_inputs = expert_inputs.reshape(ep, e_local, capacity, d)
+    expert_inputs = lax.all_to_all(
+        expert_inputs, axis_name, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(ep, e_local, capacity, d)
+    # Local experts over all source ranks' buffers: [E_local, ep*C, d].
+    h = expert_inputs.transpose(1, 0, 2, 3).reshape(
+        e_local, ep * capacity, d
+    )
+    h = jnp.einsum("ecd,edf->ecf", h, params["w_in"])
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    # Return trip: back to source ranks.
+    h = h.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+    h = lax.all_to_all(
+        h, axis_name, split_axis=0, concat_axis=0, tiled=True
+    ).reshape(num_experts, capacity, d)
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(h.dtype), h
+    )
+    return out.astype(x.dtype), aux

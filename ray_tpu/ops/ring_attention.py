@@ -84,9 +84,18 @@ def ring_attention(
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return acc_new, m_new, l_new, k_next, v_next
 
-    acc = jnp.zeros((b, h, t_local, d), jnp.float32)
-    m = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    # Initializers are device-varying over the ring axis (each rank
+    # accumulates different data) — mark them so scan's
+    # varying-manual-axes type check agrees (jax >= 0.7).
+    def _varying(x):
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+
+    acc = _varying(jnp.zeros((b, h, t_local, d), jnp.float32))
+    m = _varying(jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32))
+    l = _varying(jnp.zeros((b, h, t_local, 1), jnp.float32))
     acc, m, l, _, _ = lax.fori_loop(0, n, step, (acc, m, l, k, v))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (acc / l_safe).astype(q.dtype)
