@@ -1,0 +1,219 @@
+"""ctypes binding to the native arena store.
+
+Builds libray_tpu_store.so on first import if the toolchain is
+available (make/g++ are part of the supported image); callers fall
+back to the pure-Python per-segment store when the library can't load
+(reference split: plasma is C++, its client rides in every worker).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libray_tpu_store.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+OID_BYTES = 20
+
+RTS_OK = 0
+RTS_ERR_EXISTS = -2
+RTS_ERR_FULL = -3
+RTS_ERR_MISSING = -4
+RTS_ERR_STATE = -5
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native store; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.rts_open.restype = ctypes.c_void_p
+        lib.rts_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ]
+        lib.rts_base.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rts_base.argtypes = [ctypes.c_void_p]
+        lib.rts_create.restype = ctypes.c_int64
+        lib.rts_create.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.rts_seal.restype = ctypes.c_int
+        lib.rts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_lookup.restype = ctypes.c_int64
+        lib.rts_lookup.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+        ]
+        for name in ("rts_pin", "rts_unpin", "rts_delete"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_stats.restype = ctypes.c_int
+        lib.rts_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rts_close.restype = None
+        lib.rts_close.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+class NativeArena:
+    """Thin OO wrapper over the C surface (one arena per node)."""
+
+    def __init__(
+        self,
+        path: str,
+        capacity: int,
+        num_slots: int = 65536,
+        create: bool = True,
+    ):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self._path = path.encode()
+        self._handle = lib.rts_open(
+            self._path, capacity, num_slots, 1 if create else 0
+        )
+        if not self._handle:
+            raise RuntimeError(f"rts_open failed for {path}")
+        self._base = ctypes.cast(
+            lib.rts_base(self._handle), ctypes.c_void_p
+        ).value
+        self._closed = False
+
+    @staticmethod
+    def _key(oid: bytes) -> bytes:
+        if len(oid) > OID_BYTES:
+            raise ValueError("oid too long")
+        return oid.ljust(OID_BYTES, b"\0")
+
+    def _view(self, offset: int, size: int) -> memoryview:
+        address = self._base + offset
+        buf = (ctypes.c_char * size).from_address(address)
+        return memoryview(buf).cast("B")
+
+    def create(self, oid: bytes, size: int):
+        """Returns (writable memoryview, [evicted oids])."""
+        evicted = ctypes.create_string_buffer(OID_BYTES * 64)
+        n_evicted = ctypes.c_int(0)
+        offset = self._lib.rts_create(
+            self._handle,
+            self._key(oid),
+            max(size, 1),
+            evicted,
+            64,
+            ctypes.byref(n_evicted),
+        )
+        if offset == RTS_ERR_EXISTS:
+            raise ValueError(f"object {oid.hex()} already exists")
+        if offset < 0:
+            raise MemoryError(f"arena full (err {offset})")
+        ids = [
+            evicted.raw[i * OID_BYTES : (i + 1) * OID_BYTES]
+            for i in range(n_evicted.value)
+        ]
+        return self._view(offset, max(size, 1))[:size], ids
+
+    def seal(self, oid: bytes) -> None:
+        rc = self._lib.rts_seal(self._handle, self._key(oid))
+        if rc != RTS_OK:
+            raise KeyError(f"seal({oid.hex()}) -> {rc}")
+
+    def get(self, oid: bytes, sealed_only: bool = True):
+        size = ctypes.c_uint64(0)
+        offset = self._lib.rts_lookup(
+            self._handle,
+            self._key(oid),
+            ctypes.byref(size),
+            1 if sealed_only else 0,
+        )
+        if offset < 0:
+            return None
+        return self._view(offset, max(int(size.value), 1))[
+            : int(size.value)
+        ]
+
+    def contains(self, oid: bytes) -> bool:
+        return self.get(oid) is not None
+
+    def pin(self, oid: bytes) -> None:
+        self._lib.rts_pin(self._handle, self._key(oid))
+
+    def unpin(self, oid: bytes) -> None:
+        self._lib.rts_unpin(self._handle, self._key(oid))
+
+    def delete(self, oid: bytes) -> bool:
+        return (
+            self._lib.rts_delete(self._handle, self._key(oid)) == RTS_OK
+        )
+
+    def stats(self) -> dict:
+        capacity = ctypes.c_uint64(0)
+        used = ctypes.c_uint64(0)
+        num = ctypes.c_uint64(0)
+        self._lib.rts_stats(
+            self._handle,
+            ctypes.byref(capacity),
+            ctypes.byref(used),
+            ctypes.byref(num),
+        )
+        return {
+            "capacity": capacity.value,
+            "used": used.value,
+            "num_objects": num.value,
+        }
+
+    def close(self, unlink: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.rts_close(
+            self._handle, 1 if unlink else 0, self._path
+        )

@@ -1,0 +1,409 @@
+// Shared-memory arena object store (plasma analog).
+//
+// Reference: src/ray/object_manager/plasma/ — an mmap'd arena
+// (plasma/dlmalloc.cc) holding immutable objects behind an object
+// index with create/seal/get/release/delete + LRU eviction
+// (object_lifecycle_manager.h, eviction_policy.h). This is the
+// TPU-native C++ equivalent: one arena file per node under /dev/shm,
+// a process-shared mutex guarding a fixed-slot index + first-fit
+// free list with coalescing, and 64-byte aligned payloads so mapped
+// buffers feed jax.numpy/dlpack zero-copy.
+//
+// Exported as a C ABI for the ctypes binding in
+// ray_tpu/_native/__init__.py (the environment provides no pybind11;
+// ctypes over a stable C surface is the supported binding path).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254535052455631ULL;  // "RTSTOREV1"
+constexpr uint32_t kOidBytes = 20;
+constexpr uint32_t kAlign = 64;
+
+enum SlotState : uint32_t {
+  kFree = 0,
+  kCreating = 1,
+  kSealed = 2,
+};
+
+struct Slot {
+  uint8_t oid[kOidBytes];
+  uint32_t state;
+  uint32_t pins;
+  uint64_t offset;  // into the data heap
+  uint64_t size;
+  uint64_t lru_tick;
+};
+
+// Free-list node stored inside the header's node pool (not in the data
+// heap itself, so payload memory stays payload-only).
+struct FreeNode {
+  uint64_t offset;
+  uint64_t size;
+  int32_t next;  // index into node pool, -1 == end
+  int32_t in_use;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;       // data heap bytes
+  uint64_t used;           // allocated bytes
+  uint64_t lru_clock;
+  uint32_t num_slots;
+  uint32_t num_free_nodes;
+  int32_t free_head;       // free-list head (node index)
+  uint32_t initialized;
+  pthread_mutex_t mutex;
+  // Slot table and node pool follow; data heap after that.
+};
+
+struct Handle {
+  int fd;
+  uint8_t* map;
+  uint64_t map_size;
+  Header* header;
+  Slot* slots;
+  FreeNode* nodes;
+  uint8_t* heap;
+};
+
+uint64_t AlignUp(uint64_t v, uint64_t a) { return (v + a - 1) / a * a; }
+
+Slot* FindSlot(Handle* h, const uint8_t* oid) {
+  // Linear probe from the oid's hash position.
+  uint64_t hash = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kOidBytes; ++i) {
+    hash = (hash ^ oid[i]) * 1099511628211ULL;
+  }
+  const uint32_t n = h->header->num_slots;
+  for (uint32_t probe = 0; probe < n; ++probe) {
+    Slot* slot = &h->slots[(hash + probe) % n];
+    if (slot->state != kFree &&
+        memcmp(slot->oid, oid, kOidBytes) == 0) {
+      return slot;
+    }
+  }
+  return nullptr;
+}
+
+Slot* FindEmptySlot(Handle* h, const uint8_t* oid) {
+  uint64_t hash = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kOidBytes; ++i) {
+    hash = (hash ^ oid[i]) * 1099511628211ULL;
+  }
+  const uint32_t n = h->header->num_slots;
+  for (uint32_t probe = 0; probe < n; ++probe) {
+    Slot* slot = &h->slots[(hash + probe) % n];
+    if (slot->state == kFree) return slot;
+  }
+  return nullptr;
+}
+
+int32_t AllocNode(Handle* h) {
+  for (uint32_t i = 0; i < h->header->num_free_nodes; ++i) {
+    if (!h->nodes[i].in_use) {
+      h->nodes[i].in_use = 1;
+      return static_cast<int32_t>(i);
+    }
+  }
+  return -1;
+}
+
+// First-fit allocation from the free list.
+int64_t HeapAlloc(Handle* h, uint64_t size) {
+  Header* hd = h->header;
+  int32_t prev = -1;
+  int32_t cur = hd->free_head;
+  while (cur >= 0) {
+    FreeNode* node = &h->nodes[cur];
+    if (node->size >= size) {
+      uint64_t offset = node->offset;
+      if (node->size == size) {
+        if (prev < 0) hd->free_head = node->next;
+        else h->nodes[prev].next = node->next;
+        node->in_use = 0;
+      } else {
+        node->offset += size;
+        node->size -= size;
+      }
+      hd->used += size;
+      return static_cast<int64_t>(offset);
+    }
+    prev = cur;
+    cur = node->next;
+  }
+  return -1;
+}
+
+// Insert a free range, merging neighbors (offset-sorted list).
+void HeapFree(Handle* h, uint64_t offset, uint64_t size) {
+  Header* hd = h->header;
+  hd->used -= size;
+  int32_t prev = -1;
+  int32_t cur = hd->free_head;
+  while (cur >= 0 && h->nodes[cur].offset < offset) {
+    prev = cur;
+    cur = h->nodes[cur].next;
+  }
+  // Merge with previous?
+  if (prev >= 0 &&
+      h->nodes[prev].offset + h->nodes[prev].size == offset) {
+    h->nodes[prev].size += size;
+    // Merge previous with current?
+    if (cur >= 0 && h->nodes[prev].offset + h->nodes[prev].size ==
+                        h->nodes[cur].offset) {
+      h->nodes[prev].size += h->nodes[cur].size;
+      h->nodes[prev].next = h->nodes[cur].next;
+      h->nodes[cur].in_use = 0;
+    }
+    return;
+  }
+  // Merge with current?
+  if (cur >= 0 && offset + size == h->nodes[cur].offset) {
+    h->nodes[cur].offset = offset;
+    h->nodes[cur].size += size;
+    return;
+  }
+  int32_t fresh = AllocNode(h);
+  if (fresh < 0) return;  // node pool exhausted: leak range (rare)
+  h->nodes[fresh].offset = offset;
+  h->nodes[fresh].size = size;
+  h->nodes[fresh].next = cur;
+  if (prev < 0) hd->free_head = fresh;
+  else h->nodes[prev].next = fresh;
+}
+
+void DeleteSlotLocked(Handle* h, Slot* slot) {
+  HeapFree(h, slot->offset, AlignUp(slot->size ? slot->size : 1, kAlign));
+  slot->state = kFree;
+  slot->pins = 0;
+}
+
+// Evict LRU sealed+unpinned objects until `needed` heap bytes could
+// fit; returns evicted count, writing ids into evicted_out.
+int EvictLocked(Handle* h, uint64_t needed, uint8_t* evicted_out,
+                int max_evicted) {
+  Header* hd = h->header;
+  int count = 0;
+  while (hd->capacity - hd->used < needed && count < max_evicted) {
+    Slot* victim = nullptr;
+    for (uint32_t i = 0; i < hd->num_slots; ++i) {
+      Slot* slot = &h->slots[i];
+      if (slot->state == kSealed && slot->pins == 0 &&
+          (victim == nullptr || slot->lru_tick < victim->lru_tick)) {
+        victim = slot;
+      }
+    }
+    if (victim == nullptr) break;
+    memcpy(evicted_out + count * kOidBytes, victim->oid, kOidBytes);
+    ++count;
+    DeleteSlotLocked(h, victim);
+  }
+  return count;
+}
+
+class Locker {
+ public:
+  explicit Locker(Handle* h) : h_(h) {
+    pthread_mutex_lock(&h_->header->mutex);
+  }
+  ~Locker() { pthread_mutex_unlock(&h_->header->mutex); }
+
+ private:
+  Handle* h_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Error codes.
+#define RTS_OK 0
+#define RTS_ERR_EXISTS -2
+#define RTS_ERR_FULL -3
+#define RTS_ERR_MISSING -4
+#define RTS_ERR_STATE -5
+#define RTS_ERR_SYS -6
+
+void* rts_open(const char* path, uint64_t capacity, uint32_t num_slots,
+               int create) {
+  const uint64_t node_pool = num_slots;  // one free node per slot
+  const uint64_t meta_size =
+      AlignUp(sizeof(Header) + num_slots * sizeof(Slot) +
+                  node_pool * sizeof(FreeNode),
+              kAlign);
+  const uint64_t total = meta_size + capacity;
+  int fd = open(path, create ? (O_RDWR | O_CREAT) : O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (create) {
+    if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < total) {
+    close(fd);
+    return nullptr;
+  }
+  uint8_t* map = static_cast<uint8_t*>(mmap(
+      nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0));
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Handle* h = new Handle;
+  h->fd = fd;
+  h->map = map;
+  h->map_size = total;
+  h->header = reinterpret_cast<Header*>(map);
+  h->slots = reinterpret_cast<Slot*>(map + sizeof(Header));
+  h->nodes = reinterpret_cast<FreeNode*>(
+      map + sizeof(Header) + num_slots * sizeof(Slot));
+  h->heap = map + meta_size;
+  if (create && h->header->initialized != 1) {
+    Header* hd = h->header;
+    memset(map, 0, meta_size);
+    hd->magic = kMagic;
+    hd->capacity = capacity;
+    hd->used = 0;
+    hd->lru_clock = 0;
+    hd->num_slots = num_slots;
+    hd->num_free_nodes = static_cast<uint32_t>(node_pool);
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hd->mutex, &attr);
+    h->nodes[0].offset = 0;
+    h->nodes[0].size = capacity;
+    h->nodes[0].next = -1;
+    h->nodes[0].in_use = 1;
+    hd->free_head = 0;
+    __sync_synchronize();
+    hd->initialized = 1;
+  }
+  if (h->header->magic != kMagic) {
+    munmap(map, total);
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+uint8_t* rts_base(void* handle) {
+  return static_cast<Handle*>(handle)->heap;
+}
+
+int64_t rts_create(void* handle, const uint8_t* oid, uint64_t size,
+                   uint8_t* evicted_out, int max_evicted,
+                   int* n_evicted) {
+  Handle* h = static_cast<Handle*>(handle);
+  uint64_t need = AlignUp(size ? size : 1, kAlign);
+  Locker lock(h);
+  *n_evicted = 0;
+  if (FindSlot(h, oid) != nullptr) return RTS_ERR_EXISTS;
+  if (need > h->header->capacity) return RTS_ERR_FULL;
+  if (h->header->capacity - h->header->used < need) {
+    *n_evicted = EvictLocked(h, need, evicted_out, max_evicted);
+  }
+  int64_t offset = HeapAlloc(h, need);
+  if (offset < 0) return RTS_ERR_FULL;
+  Slot* slot = FindEmptySlot(h, oid);
+  if (slot == nullptr) {
+    HeapFree(h, static_cast<uint64_t>(offset), need);
+    return RTS_ERR_FULL;
+  }
+  memcpy(slot->oid, oid, kOidBytes);
+  slot->state = kCreating;
+  slot->pins = 0;
+  slot->offset = static_cast<uint64_t>(offset);
+  slot->size = size;
+  slot->lru_tick = ++h->header->lru_clock;
+  return offset;
+}
+
+int rts_seal(void* handle, const uint8_t* oid) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  if (slot->state != kCreating) return RTS_ERR_STATE;
+  slot->state = kSealed;
+  return RTS_OK;
+}
+
+// Looks up a SEALED object; returns offset, fills size. -4 if absent
+// or unsealed (sealed_only=0 accepts CREATING too).
+int64_t rts_lookup(void* handle, const uint8_t* oid, uint64_t* size_out,
+                   int sealed_only) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  if (sealed_only && slot->state != kSealed) return RTS_ERR_MISSING;
+  slot->lru_tick = ++h->header->lru_clock;
+  *size_out = slot->size;
+  return static_cast<int64_t>(slot->offset);
+}
+
+int rts_pin(void* handle, const uint8_t* oid) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  slot->pins += 1;
+  return RTS_OK;
+}
+
+int rts_unpin(void* handle, const uint8_t* oid) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  if (slot->pins > 0) slot->pins -= 1;
+  return RTS_OK;
+}
+
+int rts_delete(void* handle, const uint8_t* oid) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  DeleteSlotLocked(h, slot);
+  return RTS_OK;
+}
+
+int rts_stats(void* handle, uint64_t* capacity, uint64_t* used,
+              uint64_t* num_objects) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  *capacity = h->header->capacity;
+  *used = h->header->used;
+  uint64_t count = 0;
+  for (uint32_t i = 0; i < h->header->num_slots; ++i) {
+    if (h->slots[i].state != kFree) ++count;
+  }
+  *num_objects = count;
+  return RTS_OK;
+}
+
+void rts_close(void* handle, int unlink_file, const char* path) {
+  Handle* h = static_cast<Handle*>(handle);
+  munmap(h->map, h->map_size);
+  close(h->fd);
+  if (unlink_file && path != nullptr) unlink(path);
+  delete h;
+}
+
+}  // extern "C"

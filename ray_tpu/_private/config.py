@@ -35,6 +35,11 @@ class Config:
     object_pull_max_bytes_in_flight: int = 256 * 1024 * 1024
     #: Seconds between object-store eviction scans.
     object_eviction_check_interval_s: float = 1.0
+    #: Use the native C++ arena store (_native/store.cc) instead of
+    #: per-object Python shm segments. Default off this round: the
+    #: arena reuses freed ranges immediately, so it requires the
+    #: refcount-gated deletion contract end to end.
+    use_native_object_store: bool = False
 
     # ---- scheduler ----
     #: Beyond this fraction of node utilization the hybrid policy

@@ -59,7 +59,7 @@ from .ids import (
     TaskID,
     WorkerID,
 )
-from .object_store import SharedMemoryStore
+from .object_store import make_store
 from .placement_groups import (
     PGEntry,
     STRATEGIES,
@@ -154,8 +154,11 @@ class NodeDaemon:
         os.makedirs(session_dir, exist_ok=True)
 
         capacity = config.object_store_memory or _default_store_bytes()
-        self.store = SharedMemoryStore(
-            self.node_id.hex(), capacity, on_evict=self._on_store_evict
+        self.store = make_store(
+            self.node_id.hex(),
+            capacity,
+            on_evict=self._on_store_evict,
+            use_native=config.use_native_object_store,
         )
         self.scheduler = LocalScheduler(ResourceSet(resources))
         self.resources = dict(resources)
@@ -703,9 +706,16 @@ class NodeDaemon:
         return {}
 
     def _h_object_evicted(self, conn, msg):
-        """A node evicted a cached copy under memory pressure."""
+        """A node evicted a cached copy under memory pressure — or, in
+        the native-arena store, a local worker process whose create()
+        triggered eviction (no node_id in that case)."""
         oid = ObjectID(msg["oid"])
-        node_id = msg["node_id"]
+        node_id = msg.get("node_id")
+        if node_id is None:
+            # Local worker eviction: shared arena means this node's
+            # copy is gone; run the node-level eviction path.
+            self._on_store_evict(oid)
+            return {}
         with self._lock:
             entry = self.objects.get(oid)
             if entry is not None:

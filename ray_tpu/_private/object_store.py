@@ -276,3 +276,139 @@ class SharedMemoryStore:
         with self._lock:
             for oid in list(self._entries):
                 self.delete(oid, unlink=unlink)
+
+
+class NativeArenaStore:
+    """Same surface as SharedMemoryStore over the C++ arena
+    (_native/store.cc): one mmap'd /dev/shm file per node, first-fit
+    free-list allocator, process-shared index, LRU eviction in native
+    code. Accounting is arena-global (every process sees one shared
+    used/capacity), unlike the per-process bookkeeping above.
+
+    Enabled via config `use_native_object_store` (RT_use_native_object_
+    store=1). Note the plasma-style caveat: the arena reuses freed
+    ranges immediately, so deletion of an object while another process
+    still holds a zero-copy view is unsafe — the daemon only deletes
+    refcount-zero objects, which is the same contract plasma's release
+    protocol enforces.
+    """
+
+    def __init__(self, node_id_hex: str, capacity: int, on_evict=None):
+        from .._native import NativeArena
+
+        self._path = f"/dev/shm/rt_arena_{node_id_hex[:8]}"
+        self._arena = NativeArena(self._path, capacity, create=True)
+        self._on_evict = on_evict
+        self._capacity = capacity
+        self._seal_events: Dict[ObjectID, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._shutdown_done = False
+
+    def _notify_evicted(self, raw_ids) -> None:
+        if self._on_evict is None:
+            return
+        for raw in raw_ids:
+            try:
+                self._on_evict(ObjectID(raw[: ObjectID.SIZE]))
+            except Exception:
+                pass
+
+    # -- producer side -------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        try:
+            view, evicted = self._arena.create(object_id.binary(), size)
+        except MemoryError as e:
+            raise ObjectStoreFullError(str(e)) from None
+        self._notify_evicted(evicted)
+        return view
+
+    def seal(self, object_id: ObjectID) -> None:
+        self._arena.seal(object_id.binary())
+        with self._lock:
+            event = self._seal_events.pop(object_id, None)
+        if event is not None:
+            event.set()
+
+    def put(self, object_id: ObjectID, data) -> None:
+        buf = self.create(object_id, len(data))
+        buf[: len(data)] = data
+        self.seal(object_id)
+
+    # -- consumer side -------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._arena.contains(object_id.binary())
+
+    def get(
+        self, object_id: ObjectID, timeout: Optional[float] = None
+    ) -> Optional[memoryview]:
+        view = self._arena.get(object_id.binary())
+        if view is not None:
+            return view
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            event = self._seal_events.setdefault(
+                object_id, threading.Event()
+            )
+        while True:
+            remaining = (
+                None if deadline is None else deadline - time.time()
+            )
+            if remaining is not None and remaining <= 0:
+                return None
+            # Same-process seals signal the event; cross-process seals
+            # are observed by polling the shared index.
+            event.wait(timeout=min(remaining or 0.005, 0.005))
+            view = self._arena.get(object_id.binary())
+            if view is not None:
+                return view
+
+    def open_remote(self, object_id: ObjectID, size: int) -> memoryview:
+        view = self._arena.get(object_id.binary())
+        if view is None:
+            raise FileNotFoundError(
+                f"object {object_id.hex()} not in arena"
+            )
+        return view
+
+    # -- lifetime ------------------------------------------------------
+    def pin(self, object_id: ObjectID) -> None:
+        self._arena.pin(object_id.binary())
+
+    def unpin(self, object_id: ObjectID) -> None:
+        self._arena.unpin(object_id.binary())
+
+    def unlink_by_id(self, object_id: ObjectID) -> None:
+        self._arena.delete(object_id.binary())
+
+    def delete(self, object_id: ObjectID, unlink: bool = True) -> None:
+        self._arena.delete(object_id.binary())
+
+    def size_info(self) -> dict:
+        return self._arena.stats()
+
+    def shutdown(self, unlink: bool = True) -> None:
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        try:
+            self._arena.close(unlink=unlink)
+        except Exception:
+            pass
+
+
+def make_store(
+    node_id_hex: str,
+    capacity: int,
+    on_evict=None,
+    use_native: bool = False,
+):
+    """Store factory: native arena when requested and buildable, else
+    the per-segment Python store."""
+    if use_native:
+        try:
+            return NativeArenaStore(
+                node_id_hex, capacity, on_evict=on_evict
+            )
+        except Exception:
+            pass
+    return SharedMemoryStore(node_id_hex, capacity, on_evict=on_evict)

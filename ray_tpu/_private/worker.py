@@ -28,7 +28,7 @@ from ..object_ref import ObjectRef
 from .config import Config
 from .function_manager import FunctionManager
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
-from .object_store import SharedMemoryStore
+from .object_store import make_store
 from .rpc import RpcClient, RpcError
 from .serialization import SerializationContext
 from .task_spec import (
@@ -114,14 +114,25 @@ class CoreWorker:
         else:
             self.job_id = JobID.from_int(0)
             self.worker_id = WorkerID(reply["worker_id"])
-        self.store = SharedMemoryStore(
-            self.node_id.hex(), reply["store_capacity"]
+        self.store = make_store(
+            self.node_id.hex(),
+            reply["store_capacity"],
+            on_evict=self._notify_store_evict,
+            use_native=self.config.use_native_object_store,
         )
         self.serialization = SerializationContext(ref_class=ObjectRef)
         self.functions = FunctionManager(self._client)
         self._ctx = _TaskContext()
         self._ref_counts: Dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
+
+    def _notify_store_evict(self, oid: ObjectID) -> None:
+        """Arena evictions can originate in any process; tell the node
+        daemon so its object table stays truthful."""
+        try:
+            self._client.notify("object_evicted", oid=oid.binary())
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # reference counting (local handle counts -> daemon refcount)
