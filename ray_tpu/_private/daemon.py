@@ -190,6 +190,10 @@ class NodeDaemon:
         self._infeasible: Dict[TaskID, dict] = {}  # spec by task id
         self._node_clients: Dict[bytes, RpcClient] = {}
         self._node_conns: Dict[int, bytes] = {}  # conn_id -> node_id
+        # Application metrics (head): name -> aggregate state
+        # (reference: metrics agent aggregation, _private/metrics_agent
+        # .py; serving role of the OpenCensus registry).
+        self._metrics_table: Dict[str, dict] = {}
         # Placement groups: head-side registry + node-side reserved
         # bundles ((pg_id, index) -> {"resources", "committed"}).
         self.pgs: Dict[bytes, PGEntry] = {}
@@ -234,6 +238,8 @@ class NodeDaemon:
             "list_actors",
             "list_objects",
             "cluster_load",
+            "metrics_record",
+            "metrics_summary",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -2408,6 +2414,64 @@ class NodeDaemon:
             "pending_placement_groups": pending_pgs,
             "nodes": nodes,
         }
+
+    def _h_metrics_record(self, conn, msg):
+        """Batched metric records from local workers; forwarded to the
+        head's aggregate table (reference: core-worker metrics flow to
+        the node's metrics agent, then get scraped centrally)."""
+        if not self.is_head:
+            try:
+                return self.head.call(
+                    "metrics_record", records=msg["records"]
+                )
+            except RpcError:
+                return {}
+        with self._lock:
+            for kind, name, value, tags in msg["records"]:
+                tags = tuple(tuple(t) for t in tags)
+                entry = self._metrics_table.setdefault(
+                    name,
+                    {"kind": kind, "by_tags": {}},
+                )
+                for bucket in (
+                    entry,
+                    entry["by_tags"].setdefault(
+                        tags,
+                        {},
+                    ),
+                ):
+                    if kind == "counter":
+                        bucket["total"] = (
+                            bucket.get("total", 0.0) + value
+                        )
+                    elif kind == "gauge":
+                        bucket["value"] = value
+                    else:  # histogram
+                        bucket["count"] = bucket.get("count", 0) + 1
+                        bucket["sum"] = bucket.get("sum", 0.0) + value
+                        bucket["min"] = min(
+                            bucket.get("min", value), value
+                        )
+                        bucket["max"] = max(
+                            bucket.get("max", value), value
+                        )
+        return {}
+
+    def _h_metrics_summary(self, conn, msg):
+        if not self.is_head:
+            return self.head.call("metrics_summary")
+        with self._lock:
+            out = {}
+            for name, entry in self._metrics_table.items():
+                clean = {
+                    k: v for k, v in entry.items() if k != "by_tags"
+                }
+                clean["by_tags"] = {
+                    "|".join(f"{k}={v}" for k, v in tags): dict(bucket)
+                    for tags, bucket in entry["by_tags"].items()
+                }
+                out[name] = clean
+        return {"metrics": out}
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:

@@ -1,0 +1,173 @@
+"""Dashboard-lite: JSON/HTML cluster introspection over HTTP.
+
+Reference: python/ray/dashboard — an aiohttp head serving a React SPA
+plus per-node agents. The TPU-native rebuild keeps the data plane (the
+state API the SPA consumes) and serves it as JSON endpoints + a
+self-contained HTML page + a Prometheus text endpoint, from a stdlib
+HTTP thread on the driver or via `python -m ray_tpu dashboard`.
+
+Endpoints: /            — HTML summary page (auto-refreshing)
+           /api/summary — state summary
+           /api/nodes | /api/actors | /api/tasks | /api/objects
+           /api/placement_groups | /api/resources | /api/metrics
+           /metrics     — Prometheus exposition text
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="2">
+<style>body{font-family:monospace;margin:2em}table{border-collapse:
+collapse}td,th{border:1px solid #999;padding:4px 8px;text-align:left}
+h2{margin-top:1.5em}</style></head>
+<body><h1>ray_tpu cluster</h1><div id="content">%CONTENT%</div>
+</body></html>"""
+
+
+def _render_table(rows) -> str:
+    if not rows:
+        return "<i>none</i>"
+    keys = list(rows[0].keys())
+    head = "".join(f"<th>{k}</th>" for k in keys)
+    body = "".join(
+        "<tr>"
+        + "".join(f"<td>{row.get(k, '')}</td>" for k in keys)
+        + "</tr>"
+        for row in rows
+    )
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _prometheus_text(metrics: dict) -> str:
+    lines = []
+    for name, entry in metrics.items():
+        kind = entry.get("kind")
+        safe = name.replace(".", "_").replace("-", "_")
+        if kind == "counter":
+            lines.append(f"# TYPE {safe} counter")
+            lines.append(f"{safe} {entry.get('total', 0.0)}")
+        elif kind == "gauge":
+            lines.append(f"# TYPE {safe} gauge")
+            lines.append(f"{safe} {entry.get('value', 0.0)}")
+        else:
+            lines.append(f"# TYPE {safe} summary")
+            lines.append(f"{safe}_count {entry.get('count', 0)}")
+            lines.append(f"{safe}_sum {entry.get('sum', 0.0)}")
+    return "\n".join(lines) + "\n"
+
+
+class Dashboard:
+    def __init__(self, port: int = 8265):
+        from .util import state as state_api
+
+        self._state = state_api
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    status, payload, ctype = dashboard._route(self.path)
+                except Exception as e:  # noqa: BLE001 — 500 surface
+                    status = 500
+                    payload = json.dumps({"error": repr(e)}).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _collect(self, kind: str):
+        import ray_tpu
+
+        state = self._state
+        return {
+            "summary": lambda: ray_tpu.state_summary(),
+            "nodes": state.list_nodes,
+            "actors": state.list_actors,
+            "tasks": state.list_tasks,
+            "objects": state.list_objects,
+            "placement_groups": state.list_placement_groups,
+            "resources": lambda: {
+                "total": ray_tpu.cluster_resources(),
+                "available": ray_tpu.available_resources(),
+            },
+            "metrics": self._metrics,
+        }[kind]()
+
+    @staticmethod
+    def _metrics():
+        from .util.metrics import metrics_summary
+
+        return metrics_summary()
+
+    def _route(self, path: str):
+        if path.startswith("/api/"):
+            kind = path[len("/api/") :].strip("/")
+            data = self._collect(kind)
+            return (
+                200,
+                json.dumps(data, default=str).encode(),
+                "application/json",
+            )
+        if path == "/metrics":
+            return (
+                200,
+                _prometheus_text(self._metrics()).encode(),
+                "text/plain; version=0.0.4",
+            )
+        if path in ("/", "/index.html"):
+            import ray_tpu
+
+            sections = [
+                "<h2>summary</h2>"
+                + _render_table([ray_tpu.state_summary()]),
+                "<h2>resources</h2>"
+                + _render_table(
+                    [
+                        {
+                            "total": ray_tpu.cluster_resources(),
+                            "available": ray_tpu.available_resources(),
+                        }
+                    ]
+                ),
+                "<h2>nodes</h2>"
+                + _render_table(self._state.list_nodes()),
+                "<h2>actors</h2>"
+                + _render_table(self._state.list_actors()),
+                "<h2>placement groups</h2>"
+                + _render_table(self._state.list_placement_groups()),
+            ]
+            page = _PAGE.replace("%CONTENT%", "".join(sections))
+            return 200, page.encode(), "text/html"
+        return (
+            404,
+            json.dumps({"error": "not found"}).encode(),
+            "application/json",
+        )
+
+    def stop(self) -> None:
+        self._server.shutdown()
+
+
+def start_dashboard(port: int = 8265) -> Dashboard:
+    """Serve the dashboard from this (driver) process."""
+    return Dashboard(port)

@@ -1,0 +1,140 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py — application metrics recorded
+from any worker, aggregated cluster-wide (the reference flows through
+per-node metrics agents into Prometheus; here records flow through the
+node daemon's KV-style metric table on the head and are queried with
+`metrics_summary()`; a Prometheus text endpoint rides the dashboard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import exceptions as exc
+
+_FLUSH_INTERVAL_S = 0.5
+
+
+def _worker():
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    if worker is None:
+        raise exc.RayTpuError("ray_tpu.init() has not been called")
+    return worker
+
+
+class _Buffer:
+    """Per-process record buffer with a background flusher."""
+
+    _instance: Optional["_Buffer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.records: List[tuple] = []
+        self.records_lock = threading.Lock()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    @classmethod
+    def get(cls) -> "_Buffer":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def push(self, record: tuple) -> None:
+        with self.records_lock:
+            self.records.append(record)
+
+    def _loop(self) -> None:
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            self.flush()
+
+    def flush(self) -> None:
+        with self.records_lock:
+            batch, self.records = self.records, []
+        if not batch:
+            return
+        try:
+            _worker().call("metrics_record", records=batch)
+        except Exception:
+            pass
+
+
+class _Metric:
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Sequence[str] = (),
+    ):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+
+class Counter(_Metric):
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() takes a non-negative value")
+        _Buffer.get().push(
+            (self.KIND, self._name, float(value), self._tags(tags))
+        )
+
+
+class Gauge(_Metric):
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        _Buffer.get().push(
+            (self.KIND, self._name, float(value), self._tags(tags))
+        )
+
+
+class Histogram(_Metric):
+    KIND = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = (),
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries)
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        _Buffer.get().push(
+            (self.KIND, self._name, float(value), self._tags(tags))
+        )
+
+
+def flush() -> None:
+    """Force-flush this process's buffered records (tests/shutdown)."""
+    _Buffer.get().flush()
+
+
+def metrics_summary() -> Dict[str, dict]:
+    """Cluster-wide aggregated metrics: {name: {kind, total/value/
+    count, by_tags}}."""
+    flush()
+    return _worker().call("metrics_summary")["metrics"]

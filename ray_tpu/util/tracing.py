@@ -1,0 +1,66 @@
+"""Chrome-trace timeline export.
+
+Reference: `ray.timeline()` builds a chrome://tracing JSON from the
+per-task state-transition events batched into GcsTaskManager
+(core_worker/task_event_buffer.h). Our head records the same
+transitions (daemon _record_task_event); this module folds them into
+duration events: one slice per task from its first RUNNING-adjacent
+state to its final state.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import List, Optional
+
+_BEGIN_STATES = {
+    "PENDING_ARGS_AVAIL",
+    "FORWARDED",
+    "PENDING_NODE_ASSIGNMENT",
+}
+_END_STATES = {"FINISHED", "FAILED", "DONE"}
+
+
+def timeline_to_chrome_trace(
+    events: List[dict], path: Optional[str] = None
+) -> List[dict]:
+    """Fold task state events into chrome trace 'X' slices; returns the
+    trace (and writes JSON to `path` when given)."""
+    by_task = defaultdict(list)
+    for event in events:
+        by_task[event["task_id"]].append(event)
+    trace = []
+    for task_id, task_events in by_task.items():
+        task_events.sort(key=lambda e: e["time"])
+        start = task_events[0]
+        end = task_events[-1]
+        duration_us = max(1.0, (end["time"] - start["time"]) * 1e6)
+        trace.append(
+            {
+                "name": start.get("name") or start.get("kind", "task"),
+                "cat": start.get("kind", "task"),
+                "ph": "X",
+                "ts": start["time"] * 1e6,
+                "dur": duration_us,
+                "pid": "cluster",
+                "tid": task_id[:8],
+                "args": {
+                    "task_id": task_id,
+                    "final_state": end["state"],
+                    "states": [e["state"] for e in task_events],
+                },
+            }
+        )
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def export_timeline(path: str) -> List[dict]:
+    """`ray.timeline(filename=...)` equivalent: fetch events from the
+    head and write a chrome trace."""
+    import ray_tpu
+
+    return timeline_to_chrome_trace(ray_tpu.timeline(), path)

@@ -1,0 +1,132 @@
+"""Observability tests (reference test models: metric export tests,
+ray.timeline chrome trace, dashboard HTTP API)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+
+def test_metrics_counter_gauge_histogram(rt_session):
+    rt = rt_session
+    from ray_tpu.util.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+        metrics_summary,
+    )
+
+    requests = Counter("app_requests", tag_keys=("route",))
+    temperature = Gauge("app_temperature")
+    latency = Histogram("app_latency_ms")
+
+    requests.inc(1, tags={"route": "a"})
+    requests.inc(2, tags={"route": "b"})
+    temperature.set(21.5)
+    for v in (5.0, 10.0, 15.0):
+        latency.observe(v)
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        metrics = metrics_summary()
+        if "app_requests" in metrics and metrics["app_requests"].get(
+            "total"
+        ) == 3.0 and metrics.get("app_latency_ms", {}).get("count") == 3:
+            break
+        time.sleep(0.2)
+    metrics = metrics_summary()
+    assert metrics["app_requests"]["total"] == 3.0
+    assert metrics["app_requests"]["by_tags"]["route=b"]["total"] == 2.0
+    assert metrics["app_temperature"]["value"] == 21.5
+    hist = metrics["app_latency_ms"]
+    assert hist["count"] == 3 and hist["sum"] == 30.0
+    assert hist["min"] == 5.0 and hist["max"] == 15.0
+
+
+def test_metrics_from_tasks(rt_session):
+    rt = rt_session
+    from ray_tpu.util.metrics import Counter, metrics_summary
+
+    @rt.remote
+    def work(i):
+        from ray_tpu.util.metrics import Counter, flush
+
+        Counter("task_side_counter").inc(1)
+        flush()
+        return i
+
+    rt.get([work.remote(i) for i in range(5)], timeout=60)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        metrics = metrics_summary()
+        if metrics.get("task_side_counter", {}).get("total") == 5.0:
+            break
+        time.sleep(0.2)
+    assert metrics_summary()["task_side_counter"]["total"] == 5.0
+
+
+def test_chrome_trace_export(rt_session, tmp_path):
+    rt = rt_session
+    from ray_tpu.util.tracing import export_timeline
+
+    @rt.remote
+    def traced(x):
+        return x + 1
+
+    rt.get([traced.remote(i) for i in range(3)], timeout=30)
+    path = str(tmp_path / "trace.json")
+    trace = export_timeline(path)
+    assert len(trace) >= 3
+    with open(path) as f:
+        loaded = json.load(f)
+    slices = [e for e in loaded if e["name"] == "traced"]
+    assert len(slices) == 3
+    for event in slices:
+        assert event["ph"] == "X" and event["dur"] >= 1
+
+
+def test_dashboard_endpoints(rt_session):
+    rt = rt_session
+    import socket
+
+    from ray_tpu.dashboard import start_dashboard
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    dash = start_dashboard(port)
+    try:
+
+        @rt.remote
+        class Marker:
+            def ping(self):
+                return 1
+
+        marker = Marker.remote()
+        rt.get(marker.ping.remote(), timeout=30)
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as resp:
+                return resp.read()
+
+        nodes = json.loads(fetch("/api/nodes"))
+        assert len(nodes) == 1
+        actors = json.loads(fetch("/api/actors"))
+        assert any(a["class_name"] == "Marker" for a in actors)
+        resources = json.loads(fetch("/api/resources"))
+        assert "CPU" in resources["total"]
+        html = fetch("/").decode()
+        assert "ray_tpu cluster" in html and "Marker" in html
+
+        from ray_tpu.util.metrics import Counter, flush
+
+        Counter("dash_metric").inc(2)
+        flush()
+        time.sleep(0.3)
+        prom = fetch("/metrics").decode()
+        assert "dash_metric 2.0" in prom
+    finally:
+        dash.stop()
