@@ -242,6 +242,8 @@ class CoreWorker:
         num_returns: int = 1,
         timeout: Optional[float] = None,
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if not refs:
+            return [], []
         by_id = {r.binary(): r for r in refs}
         reply = self._client.call(
             "wait_objects",

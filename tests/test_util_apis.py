@@ -1,0 +1,130 @@
+"""util API tests: collective groups, ActorPool, Queue (reference
+test models: util/collective tests, test_actor_pool.py,
+test_queue.py)."""
+
+import numpy as np
+import pytest
+
+
+def test_collective_group_allreduce_across_tasks(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu.util.collective import init_collective_group
+
+        group = init_collective_group(world, rank, "g1")
+        reduced = group.allreduce(np.full(4, rank + 1.0))
+        gathered = group.allgather(np.array([rank]))
+        got = group.broadcast(
+            np.array([42.0]) if rank == 0 else None, src_rank=0
+        )
+        shard = group.reducescatter(np.arange(4, dtype=np.float64))
+        group.barrier()
+        return (
+            reduced.tolist(),
+            [int(g[0]) for g in gathered],
+            float(got[0]),
+            shard.tolist(),
+        )
+
+    world = 3
+    results = rt.get(
+        [member.remote(rank, world) for rank in range(world)],
+        timeout=120,
+    )
+    from ray_tpu.util.collective import destroy_collective_group
+
+    destroy_collective_group("g1")
+    for rank, (reduced, gathered, got, shard) in enumerate(results):
+        assert reduced == [6.0] * 4  # 1+2+3
+        assert gathered == [0, 1, 2]
+        assert got == 42.0
+    # reducescatter shards the reduced tensor across ranks.
+    all_shards = [r[3] for r in results]
+    flat = [v for shard in all_shards for v in shard]
+    assert flat == [0.0, 3.0, 6.0, 9.0]
+
+
+def test_collective_p2p(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    def member(rank):
+        import numpy as np
+
+        from ray_tpu.util.collective import init_collective_group
+
+        group = init_collective_group(2, rank, "p2p")
+        if rank == 0:
+            group.send(np.array([7.0, 8.0]), dst_rank=1)
+            return None
+        return group.recv(src_rank=0).tolist()
+
+    results = rt.get(
+        [member.remote(0), member.remote(1)], timeout=120
+    )
+    from ray_tpu.util.collective import destroy_collective_group
+
+    destroy_collective_group("p2p")
+    assert results[1] == [7.0, 8.0]
+
+
+def test_actor_pool_ordered_and_unordered(rt_session):
+    rt = rt_session
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @rt.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    results = list(
+        pool.map(lambda a, v: a.double.remote(v), range(6))
+    )
+    assert results == [0, 2, 4, 6, 8, 10]
+
+    unordered = sorted(
+        pool.map_unordered(lambda a, v: a.double.remote(v), range(6))
+    )
+    assert unordered == [0, 2, 4, 6, 8, 10]
+
+
+def test_queue_cross_task(rt_session):
+    rt = rt_session
+    from ray_tpu.util.queue import Queue
+
+    queue = Queue(maxsize=10)
+
+    @rt.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return "done"
+
+    @rt.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(queue, 5)
+    c = consumer.remote(queue, 5)
+    assert rt.get(p, timeout=60) == "done"
+    assert rt.get(c, timeout=60) == [0, 1, 2, 3, 4]
+    assert queue.empty()
+    queue.shutdown()
+
+
+def test_queue_full_and_empty(rt_session):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    queue = Queue(maxsize=1)
+    queue.put("x")
+    with pytest.raises(Full):
+        queue.put("y", block=False)
+    assert queue.get() == "x"
+    with pytest.raises(Empty):
+        queue.get(block=False)
+    queue.shutdown()
