@@ -41,6 +41,14 @@ class Config:
     #: refcount-gated deletion contract end to end.
     use_native_object_store: bool = False
 
+    # ---- memory monitor (reference: memory_monitor.h:52, threshold
+    # ray_config_def.h:65 memory_usage_threshold) ----
+    #: Node memory fraction beyond which the OOM killer picks a worker.
+    memory_usage_threshold: float = 0.95
+    #: Sample interval in ms; 0 disables the monitor (default: opt-in,
+    #: the hermetic test environment shares the host with other jobs).
+    memory_monitor_refresh_ms: int = 0
+
     # ---- scheduler ----
     #: Beyond this fraction of node utilization the hybrid policy
     #: spreads instead of packing (reference:

@@ -190,6 +190,7 @@ class NodeDaemon:
         self._infeasible: Dict[TaskID, dict] = {}  # spec by task id
         self._node_clients: Dict[bytes, RpcClient] = {}
         self._node_conns: Dict[int, bytes] = {}  # conn_id -> node_id
+        self._memory_monitor = None
         # Application metrics (head): name -> aggregate state
         # (reference: metrics agent aggregation, _private/metrics_agent
         # .py; serving role of the OpenCensus registry).
@@ -287,6 +288,16 @@ class NodeDaemon:
 
     def start(self) -> None:
         self.server.start()
+        if self.config.memory_monitor_refresh_ms > 0:
+            from .memory_monitor import MemoryMonitor
+
+            self._memory_monitor = MemoryMonitor(
+                self.config.memory_usage_threshold,
+                self.config.memory_monitor_refresh_ms / 1000.0,
+                self._oom_candidates,
+                self._oom_kill,
+            )
+            self._memory_monitor.start()
         if not self.is_head:
             self.head = RpcClient(self.head_address)
             self.head.call(
@@ -2415,6 +2426,42 @@ class NodeDaemon:
             "nodes": nodes,
         }
 
+    # ------------------------------------------------------------------
+    # OOM defense (reference: MemoryMonitor + worker killing policies)
+    # ------------------------------------------------------------------
+    def _oom_candidates(self) -> list:
+        from .memory_monitor import process_rss
+
+        out = []
+        with self._lock:
+            workers = list(self.workers.values())
+            for winfo in workers:
+                if winfo.idle or winfo.current_task is None:
+                    continue
+                entry = self.tasks.get(winfo.current_task)
+                retriable = (
+                    entry is not None and entry.retries_left > 0
+                )
+                out.append(
+                    {
+                        "pid": winfo.pid,
+                        "task_id": winfo.current_task,
+                        "retriable": retriable,
+                        "rss": process_rss(winfo.pid),
+                    }
+                )
+        return out
+
+    def _oom_kill(self, victim: dict) -> None:
+        """SIGKILL the chosen worker; the normal worker-death path
+        retries or fails its task."""
+        import signal
+
+        try:
+            os.kill(victim["pid"], signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
     def _h_metrics_record(self, conn, msg):
         """Batched metric records from local workers; forwarded to the
         head's aggregate table (reference: core-worker metrics flow to
@@ -2493,6 +2540,8 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        if self._memory_monitor is not None:
+            self._memory_monitor.stop()
         for proc in self._worker_procs:
             try:
                 proc.kill()

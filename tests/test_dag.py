@@ -164,10 +164,14 @@ def test_compiled_throughput_beats_rpc(rt_session):
     rt.get(e.hit.remote(0), timeout=20)  # warm the worker
     n = 200
 
-    start = time.perf_counter()
-    for i in range(n):
-        rt.get(e.hit.remote(i), timeout=20)
-    rpc_time = time.perf_counter() - start
+    def time_rpc():
+        start = time.perf_counter()
+        for i in range(n):
+            rt.get(e.hit.remote(i), timeout=20)
+        return time.perf_counter() - start
+
+    # Two measurements, best-of, to shrug off CI timing noise.
+    rpc_time = min(time_rpc(), time_rpc())
 
     from ray_tpu.dag import InputNode, experimental_compile
 
@@ -175,11 +179,15 @@ def test_compiled_throughput_beats_rpc(rt_session):
         dag = e.hit.bind(inp)
     compiled = experimental_compile(dag)
     try:
+
+        def time_compiled():
+            start = time.perf_counter()
+            for i in range(n):
+                compiled.execute(i).get(timeout=30)
+            return time.perf_counter() - start
+
         compiled.execute(0).get(timeout=30)  # warm the loop
-        start = time.perf_counter()
-        for i in range(n):
-            compiled.execute(i).get(timeout=30)
-        compiled_time = time.perf_counter() - start
+        compiled_time = min(time_compiled(), time_compiled())
     finally:
         compiled.teardown()
     assert compiled_time < rpc_time

@@ -1,0 +1,102 @@
+"""Memory monitor / OOM defense tests (reference test model:
+memory-monitor unit tests + OOM killing policy tests)."""
+
+import time
+
+import pytest
+
+
+def test_victim_policy_prefers_retriable_then_largest():
+    from ray_tpu._private.memory_monitor import pick_victim
+
+    candidates = [
+        {"pid": 1, "retriable": False, "rss": 900},
+        {"pid": 2, "retriable": True, "rss": 100},
+        {"pid": 3, "retriable": True, "rss": 500},
+    ]
+    assert pick_victim(candidates)["pid"] == 3  # retriable, biggest
+    assert pick_victim([candidates[0]])["pid"] == 1
+    assert pick_victim([]) is None
+
+
+def test_monitor_tick_thresholds():
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    killed = []
+    usage = {"value": 0.5}
+    monitor = MemoryMonitor(
+        usage_threshold=0.9,
+        refresh_interval_s=10,
+        get_candidates=lambda: [
+            {"pid": 42, "retriable": True, "rss": 1}
+        ],
+        kill_worker=lambda v: killed.append(v["pid"]),
+        usage_fn=lambda: usage["value"],
+        min_kill_interval_s=0.0,
+    )
+    assert monitor.tick() is False  # below threshold
+    usage["value"] = 0.95
+    assert monitor.tick() is True
+    assert killed == [42]
+
+
+def test_node_usage_fraction_sane():
+    from ray_tpu._private.memory_monitor import (
+        node_memory_usage_fraction,
+        process_rss,
+    )
+    import os
+
+    frac = node_memory_usage_fraction()
+    assert 0.0 < frac < 1.0
+    assert process_rss(os.getpid()) > 1024 * 1024
+
+
+def test_oom_kill_end_to_end():
+    """threshold=0 makes every sample an OOM: the running task's
+    worker is killed and the task fails as a worker crash (retries
+    exhausted)."""
+    import ray_tpu as rt
+    import ray_tpu.exceptions as exc
+
+    rt.init(
+        num_cpus=2,
+        _system_config={
+            "memory_monitor_refresh_ms": 50,
+            "memory_usage_threshold": 0.0,
+        },
+    )
+    try:
+
+        @rt.remote(max_retries=0)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        with pytest.raises(exc.WorkerCrashedError):
+            rt.get(hog.remote(), timeout=30)
+    finally:
+        rt.shutdown()
+
+
+def test_oom_retry_then_success():
+    """A retriable task killed once can still finish after the memory
+    pressure clears (monitor's min-kill-interval gives it room)."""
+    import ray_tpu as rt
+
+    rt.init(
+        num_cpus=2,
+        _system_config={
+            "memory_monitor_refresh_ms": 200,
+            "memory_usage_threshold": 1.01,  # never triggers
+        },
+    )
+    try:
+
+        @rt.remote(max_retries=2)
+        def quick():
+            return "done"
+
+        assert rt.get(quick.remote(), timeout=30) == "done"
+    finally:
+        rt.shutdown()
