@@ -1,0 +1,218 @@
+"""Autoregressive decoding for the Llama family.
+
+The serving-side counterpart of models/llama.py (the reference serves
+models through vLLM-on-Ray rather than shipping its own decoder; a
+TPU-native framework needs one in-tree). Decode is a two-phase jitted
+program, the standard TPU inference shape:
+
+  * prefill — one full forward over the padded prompt writes the KV
+    cache (flash attention, MXU-bound);
+  * decode  — `lax.scan` over steps, each a single-token forward
+    against the cache (HBM-bandwidth-bound), with greedy / temperature
+    / top-k sampling under a fixed token budget (static shapes; rows
+    that hit EOS keep computing but emit padding — the XLA-friendly
+    trade).
+
+The KV cache layout [layers, batch, heads, max_len, head_dim] shards
+over tp on heads, so tensor-parallel decode needs no cache reshuffle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import apply_rotary, rms_norm, rotary_embedding, swiglu
+from .llama import LlamaConfig
+
+
+def init_kv_cache(
+    cfg: LlamaConfig, batch: int, max_len: int
+) -> Dict[str, jax.Array]:
+    shape = (
+        cfg.n_layers,
+        batch,
+        cfg.n_kv_heads,
+        max_len,
+        cfg.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _layer_with_cache(
+    cfg: LlamaConfig,
+    x: jax.Array,  # [b, t, dim]
+    layer: Dict[str, jax.Array],
+    cos,
+    sin,
+    k_cache,  # [b, kv_heads, max_len, hd]
+    v_cache,
+    cache_pos: jax.Array,  # [] start offset of x in the sequence
+    valid_len: jax.Array,  # [] total valid length incl. x
+):
+    b, t, _ = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"])
+    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, 0, cache_pos, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, 0, cache_pos, 0)
+    )
+    max_len = k_cache.shape[2]
+    groups = cfg.n_heads // cfg.n_kv_heads
+    kf = jnp.repeat(k_cache, groups, axis=1)
+    vf = jnp.repeat(v_cache, groups, axis=1)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = (
+        jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32),
+            kf.astype(jnp.float32),
+        )
+        * scale
+    )
+    # Causal + cache-validity mask over absolute positions.
+    q_pos = cache_pos + jnp.arange(t)
+    k_pos = jnp.arange(max_len)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (
+        k_pos[None, :] < valid_len
+    )
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vf.astype(jnp.float32))
+    attn = attn.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(b, t, -1)
+    x = x + attn @ layer["wo"]
+    h = rms_norm(x, layer["mlp_norm"])
+    x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+    return x, k_cache, v_cache
+
+
+def _forward_with_cache(
+    params, cfg: LlamaConfig, tokens, cache, cache_pos, valid_len
+):
+    """tokens [b, t] -> (logits [b, t, vocab], new cache)."""
+    b, t = tokens.shape
+    positions = cache_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_cache, v_cache = inputs
+        x, k_cache, v_cache = _layer_with_cache(
+            cfg, x, layer, cos, sin, k_cache, v_cache, cache_pos,
+            valid_len,
+        )
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": cache["length"]}
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    """logits [b, vocab] -> token ids [b]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        top_vals, _ = jax.lax.top_k(logits, top_k)
+        cutoff = top_vals[:, -1][:, None]
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "max_new_tokens",
+        "temperature",
+        "top_k",
+        "eos_token",
+    ),
+)
+def generate(
+    params: Dict[str, Any],
+    prompt_tokens: jax.Array,  # [b, prompt_len] padded with pad_id
+    prompt_lengths: jax.Array,  # [b] true lengths
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int = 64,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    eos_token: int = -1,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (generated [b, max_new_tokens], lengths [b]).
+
+    Static token budget; rows that emit `eos_token` stop counting (the
+    returned per-row length excludes everything after EOS) but keep
+    stepping — shapes stay static for XLA.
+    """
+    b, prompt_len = prompt_tokens.shape
+    max_len = prompt_len + max_new_tokens
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, max_len)
+
+    # Phase 1: prefill the cache with the full (padded) prompt.
+    logits, cache = _forward_with_cache(
+        params,
+        cfg,
+        prompt_tokens,
+        cache,
+        jnp.int32(0),
+        jnp.int32(prompt_len),
+    )
+    # Next-token logits come from each row's LAST VALID position.
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+
+    def step(carry, key):
+        cache, last_logits, position, alive = carry
+        token = _sample(last_logits, key, temperature, top_k)
+        token = jnp.where(alive, token, 0)
+        logits, cache = _forward_with_cache(
+            params,
+            cfg,
+            token[:, None],
+            cache,
+            position,
+            position + 1,
+        )
+        next_alive = alive & (token != eos_token)
+        return (
+            (cache, logits[:, 0], position + 1, next_alive),
+            (token, alive),
+        )
+
+    keys = jax.random.split(rng, max_new_tokens)
+    # NOTE: rows shorter than prompt_len decode against a cache that
+    # includes pad positions; masking uses valid_len = full prefix, so
+    # equal-length prompts are exact and ragged batches approximate
+    # (standard left-pad serving handles raggedness upstream).
+    _, (tokens, alive_flags) = jax.lax.scan(
+        step,
+        (cache, last, jnp.int32(prompt_len), jnp.ones(b, bool)),
+        keys,
+    )
+    tokens = tokens.T  # [b, max_new_tokens]
+    lengths = jnp.sum(alive_flags.T.astype(jnp.int32), axis=1)
+    return tokens, lengths
