@@ -39,15 +39,17 @@ def load_library() -> Optional[ctypes.CDLL]:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
-            try:
-                subprocess.run(
-                    ["make", "-C", _DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
+        # Always invoke make: it no-ops when the .so is fresh and
+        # rebuilds when store.cc changed (a stale .so must never load).
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_SO):
                 _load_failed = True
                 return None
         try:

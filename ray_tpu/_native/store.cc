@@ -188,33 +188,38 @@ void DeleteSlotLocked(Handle* h, Slot* slot) {
   slot->pins = 0;
 }
 
-// Evict LRU sealed+unpinned objects until `needed` heap bytes could
-// fit; returns evicted count, writing ids into evicted_out.
-int EvictLocked(Handle* h, uint64_t needed, uint8_t* evicted_out,
-                int max_evicted) {
+// Evict the single LRU sealed+unpinned object; false if none exists.
+bool EvictOneLocked(Handle* h, uint8_t* evicted_out, int* count,
+                    int max_evicted) {
+  if (*count >= max_evicted) return false;
   Header* hd = h->header;
-  int count = 0;
-  while (hd->capacity - hd->used < needed && count < max_evicted) {
-    Slot* victim = nullptr;
-    for (uint32_t i = 0; i < hd->num_slots; ++i) {
-      Slot* slot = &h->slots[i];
-      if (slot->state == kSealed && slot->pins == 0 &&
-          (victim == nullptr || slot->lru_tick < victim->lru_tick)) {
-        victim = slot;
-      }
+  Slot* victim = nullptr;
+  for (uint32_t i = 0; i < hd->num_slots; ++i) {
+    Slot* slot = &h->slots[i];
+    if (slot->state == kSealed && slot->pins == 0 &&
+        (victim == nullptr || slot->lru_tick < victim->lru_tick)) {
+      victim = slot;
     }
-    if (victim == nullptr) break;
-    memcpy(evicted_out + count * kOidBytes, victim->oid, kOidBytes);
-    ++count;
-    DeleteSlotLocked(h, victim);
   }
-  return count;
+  if (victim == nullptr) return false;
+  memcpy(evicted_out + *count * kOidBytes, victim->oid, kOidBytes);
+  ++(*count);
+  DeleteSlotLocked(h, victim);
+  return true;
 }
 
 class Locker {
  public:
   explicit Locker(Handle* h) : h_(h) {
-    pthread_mutex_lock(&h_->header->mutex);
+    int rc = pthread_mutex_lock(&h_->header->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock (e.g. the OOM killer SIGKILLed
+      // a worker mid-create). The shared state may hold a CREATING
+      // slot that will never seal — acceptable garbage — but the
+      // mutex must be marked consistent or it becomes permanently
+      // unusable (ENOTRECOVERABLE) for every process.
+      pthread_mutex_consistent(&h_->header->mutex);
+    }
   }
   ~Locker() { pthread_mutex_unlock(&h_->header->mutex); }
 
@@ -314,10 +319,14 @@ int64_t rts_create(void* handle, const uint8_t* oid, uint64_t size,
   *n_evicted = 0;
   if (FindSlot(h, oid) != nullptr) return RTS_ERR_EXISTS;
   if (need > h->header->capacity) return RTS_ERR_FULL;
-  if (h->header->capacity - h->header->used < need) {
-    *n_evicted = EvictLocked(h, need, evicted_out, max_evicted);
-  }
+  // Keep evicting LRU victims until a contiguous range exists —
+  // byte-count checks alone miss fragmentation (freed neighbors must
+  // coalesce before a large allocation fits).
   int64_t offset = HeapAlloc(h, need);
+  while (offset < 0 &&
+         EvictOneLocked(h, evicted_out, n_evicted, max_evicted)) {
+    offset = HeapAlloc(h, need);
+  }
   if (offset < 0) return RTS_ERR_FULL;
   Slot* slot = FindEmptySlot(h, oid);
   if (slot == nullptr) {

@@ -349,18 +349,25 @@ class NativeArenaStore:
             event = self._seal_events.setdefault(
                 object_id, threading.Event()
             )
-        while True:
-            remaining = (
-                None if deadline is None else deadline - time.time()
-            )
-            if remaining is not None and remaining <= 0:
-                return None
-            # Same-process seals signal the event; cross-process seals
-            # are observed by polling the shared index.
-            event.wait(timeout=min(remaining or 0.005, 0.005))
-            view = self._arena.get(object_id.binary())
-            if view is not None:
-                return view
+        try:
+            while True:
+                remaining = (
+                    None if deadline is None else deadline - time.time()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                # Same-process seals signal the event; cross-process
+                # seals are observed by polling the shared index.
+                event.wait(timeout=min(remaining or 0.005, 0.005))
+                view = self._arena.get(object_id.binary())
+                if view is not None:
+                    return view
+        finally:
+            # Cross-process seals never pop the event in seal(); drop
+            # it here so long-lived consumers don't accumulate one
+            # Event per object ever fetched.
+            with self._lock:
+                self._seal_events.pop(object_id, None)
 
     def open_remote(self, object_id: ObjectID, size: int) -> memoryview:
         view = self._arena.get(object_id.binary())

@@ -102,11 +102,44 @@ def prepare_runtime_env(
     return wire
 
 
+# Driver-side upload memo: (worker generation, realpath, dir
+# signature) -> wire dict. Submitting many tasks with the same
+# runtime_env must not re-zip the tree or re-download the package per
+# submit (reference: URI caching in runtime_env/working_dir.py).
+_upload_memo: Dict[tuple, dict] = {}
+
+
+def _dir_signature(path: str) -> tuple:
+    """Cheap change detector: (file count, total size, max mtime)."""
+    count = total = 0
+    latest = 0.0
+    for root, _, files in os.walk(path):
+        for name in files:
+            try:
+                st = os.stat(os.path.join(root, name))
+            except OSError:
+                continue
+            count += 1
+            total += st.st_size
+            latest = max(latest, st.st_mtime)
+    return (count, total, latest)
+
+
 def _upload_dir(path: str, worker, nest_under_name: bool = False) -> dict:
     if not os.path.isdir(path):
         raise exc.RuntimeEnvSetupError(
             f"runtime_env dir {path!r} does not exist"
         )
+    real = os.path.realpath(path)
+    memo_key = (
+        worker.generation,
+        real,
+        nest_under_name,
+        _dir_signature(real),
+    )
+    cached = _upload_memo.get(memo_key)
+    if cached is not None:
+        return cached
     data = _zip_dir(
         path, prefix=os.path.basename(path.rstrip(os.sep))
         if nest_under_name
@@ -114,10 +147,12 @@ def _upload_dir(path: str, worker, nest_under_name: bool = False) -> dict:
     )
     digest = hashlib.sha256(data).hexdigest()[:16]
     key = f"__rt_pkg__{digest}"
-    # Upload once per content hash (KV is the package store).
-    if worker.call("kv_get", key=key).get("value") is None:
+    # Existence check via key listing (never downloads the package).
+    if key not in worker.call("kv_keys", prefix=key).get("keys", []):
         worker.call("kv_put", key=key, value=data)
-    return {"key": key, "hash": digest, "name": os.path.basename(path)}
+    wire = {"key": key, "hash": digest, "name": os.path.basename(path)}
+    _upload_memo[memo_key] = wire
+    return wire
 
 
 def _fetch_package(pkg: dict, worker) -> str:

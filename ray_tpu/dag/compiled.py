@@ -103,6 +103,7 @@ class CompiledDAG:
         self._buffer = buffer_size_bytes
         self._lock = threading.Lock()
         self._read_mutex = threading.Lock()
+        self._submit_mutex = threading.Lock()
         self._next_seq = 0
         self._next_read_seq = 0
         self._results: Dict[int, Any] = {}
@@ -198,14 +199,21 @@ class CompiledDAG:
         return chan
 
     # -- execution -----------------------------------------------------
-    def execute(self, value: Any) -> CompiledDAGRef:
-        with self._lock:
-            if self._torn_down:
-                raise RuntimeError("compiled DAG was torn down")
-            seq = self._next_seq
-            self._next_seq += 1
+    def execute(
+        self, value: Any, *, timeout: Optional[float] = 30.0
+    ) -> CompiledDAGRef:
+        # Input writes happen under a dedicated submit mutex (ordering
+        # across concurrent executes) with a bounded put, so a stalled
+        # or dead stage surfaces as ChannelTimeoutError instead of
+        # blocking the state lock — which teardown() also needs.
+        with self._submit_mutex:
+            with self._lock:
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG was torn down")
+                seq = self._next_seq
+                self._next_seq += 1
             for chan in self._input_channels:
-                chan.put(("v", value))
+                chan.put(("v", value), timeout=timeout)
         return CompiledDAGRef(self, seq)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
@@ -225,9 +233,12 @@ class CompiledDAG:
                         raise RuntimeError(
                             f"result {seq} was already consumed"
                         )
-                    self._next_read_seq = current + 1
+                # Commit the read-cursor bump only after the channel
+                # read succeeds: a timeout here must leave the
+                # seq->record mapping intact for retries.
                 result = self._read_channels_once(timeout)
                 with self._lock:
+                    self._next_read_seq = current + 1
                     if current == seq:
                         return result
                     self._results[current] = result
@@ -256,6 +267,9 @@ class CompiledDAG:
             if self._torn_down:
                 return
             self._torn_down = True
+        # Stop tokens go through the submit mutex like any execute
+        # (bounded puts: a wedged stage can't hang teardown).
+        with self._submit_mutex:
             for chan in self._input_channels:
                 try:
                     chan.put(("s", None), timeout=5)

@@ -155,8 +155,12 @@ class Dataset:
                 for chunk in rt.get([sample_task.remote(r) for r in refs])
                 for v in chunk
             )
+            if not samples:
+                return refs  # all blocks empty: nothing to sort
             bounds = [
-                samples[(i + 1) * len(samples) // n]
+                samples[
+                    min((i + 1) * len(samples) // n, len(samples) - 1)
+                ]
                 for i in range(n - 1)
             ]
 

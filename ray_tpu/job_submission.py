@@ -135,7 +135,11 @@ class JobManager:
             return False
         proc.terminate()
         with self._lock:
-            job["status"] = JobStatus.STOPPED.value
+            # The watcher may have recorded completion between our
+            # snapshot and the terminate — don't overwrite a final
+            # SUCCEEDED/FAILED with STOPPED.
+            if job["status"] == JobStatus.RUNNING.value:
+                job["status"] = JobStatus.STOPPED.value
         return True
 
     def list(self) -> List[dict]:

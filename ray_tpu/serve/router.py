@@ -228,22 +228,28 @@ class DeploymentHandle:
             self._reporter.start()
 
     def _report_loop(self) -> None:
-        import ray_tpu as rt
-
-        while True:
-            time.sleep(0.25)
-            try:
-                controller = _controller()
-                with self._lock:
-                    ongoing = self._sent - self._done
-                controller.report_metrics.remote(
-                    self.app_name,
-                    self.deployment_name,
-                    self._handle_id,
-                    float(max(0, ongoing)),
-                )
-            except Exception:
-                return
+        try:
+            while True:
+                time.sleep(0.25)
+                try:
+                    controller = _controller()
+                    with self._lock:
+                        ongoing = self._sent - self._done
+                    controller.report_metrics.remote(
+                        self.app_name,
+                        self.deployment_name,
+                        self._handle_id,
+                        float(max(0, ongoing)),
+                    )
+                except Exception:
+                    # Transient controller hiccups (redeploys, races)
+                    # must not kill autoscaling reporting for good.
+                    continue
+        finally:
+            # If the thread ever exits (interpreter teardown), allow a
+            # later send to restart it.
+            with self._lock:
+                self._reporter = None
 
     # -- calls ---------------------------------------------------------
     def __getattr__(self, name: str) -> "DeploymentHandle":

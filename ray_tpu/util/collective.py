@@ -29,38 +29,44 @@ _GROUP_NAMESPACE = "_rt_collective"
 
 
 class _Rendezvous:
-    """Actor body: barrier + gather/publish per (group, seq)."""
+    """Actor body: barrier + gather/publish per (group, op, seq); each
+    round completes when its declared participant set has put."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._lock = threading.Lock()
         self._rounds: Dict[tuple, dict] = {}
 
-    def put(self, op: str, seq: int, rank: int, value):
+    def put(self, op: str, seq: int, rank: int, value, expected=None):
         key = (op, seq)
         with self._lock:
             entry = self._rounds.setdefault(
-                key, {"values": {}, "result": None}
+                key,
+                {
+                    "values": {},
+                    "expected": expected
+                    or list(range(self.world_size)),
+                },
             )
             entry["values"][rank] = value
         return True
 
     def ready(self, op: str, seq: int) -> bool:
-        with self._lock:
-            entry = self._rounds.get((op, seq))
-            return (
-                entry is not None
-                and len(entry["values"]) >= self.world_size
-            )
+        return self.gather(op, seq) is not None
 
     def gather(self, op: str, seq: int):
         with self._lock:
             entry = self._rounds.get((op, seq))
-            if entry is None or len(entry["values"]) < self.world_size:
+            if entry is None:
                 return None
-            return [
-                entry["values"][r] for r in range(self.world_size)
-            ]
+            expected = entry["expected"]
+            if any(r not in entry["values"] for r in expected):
+                return None
+            # Dense list indexed by rank; non-participants hold None.
+            out = [None] * self.world_size
+            for rank in expected:
+                out[rank] = entry["values"][rank]
+            return out
 
     def clear(self, op: str, seq: int):
         with self._lock:
@@ -75,7 +81,10 @@ class CollectiveGroup:
         self.name = name
         self.rank = rank
         self.world_size = world_size
-        self._seq = 0
+        # Per-op sequence counters: ops with different participant
+        # sets (p2p vs group-wide) must not share one counter, or a
+        # p2p pair desyncs everyone else's round numbering.
+        self._seq: Dict[str, int] = {}
 
     def _actor(self):
         import ray_tpu as rt
@@ -84,14 +93,28 @@ class CollectiveGroup:
             f"collective:{self.name}", namespace=_GROUP_NAMESPACE
         )
 
-    def _exchange(self, op: str, value, timeout: float):
+    def _exchange(
+        self,
+        op: str,
+        value,
+        timeout: float,
+        participants: Optional[List[int]] = None,
+    ):
+        """One rendezvous round. `participants` defaults to the whole
+        group; p2p rounds pass the two endpoints."""
         import ray_tpu as rt
 
         actor = self._actor()
-        seq = self._seq
-        self._seq += 1
+        seq = self._seq.get(op, 0)
+        self._seq[op] = seq + 1
+        expected = (
+            sorted(participants)
+            if participants is not None
+            else list(range(self.world_size))
+        )
         rt.get(
-            actor.put.remote(op, seq, self.rank, value), timeout=timeout
+            actor.put.remote(op, seq, self.rank, value, expected),
+            timeout=timeout,
         )
         deadline = time.time() + timeout
         while True:
@@ -99,9 +122,10 @@ class CollectiveGroup:
                 actor.gather.remote(op, seq), timeout=timeout
             )
             if values is not None:
-                if self.rank == 0:
-                    # Best-effort cleanup once everyone could read.
-                    actor.clear.remote(op, seq + (-1))
+                if self.rank == expected[0]:
+                    # Best-effort cleanup of the previous round once
+                    # this one (which all participants reached) formed.
+                    actor.clear.remote(op, seq - 1)
                 return values
             if time.time() > deadline:
                 raise TimeoutError(
@@ -150,13 +174,19 @@ class CollectiveGroup:
         return shards[self.rank]
 
     def send(self, tensor, dst_rank: int, timeout: float = 60.0):
-        self._exchange(f"p2p:{self.rank}->{dst_rank}", np.asarray(
-            tensor
-        ), timeout)
+        self._exchange(
+            f"p2p:{self.rank}->{dst_rank}",
+            np.asarray(tensor),
+            timeout,
+            participants=[self.rank, dst_rank],
+        )
 
     def recv(self, src_rank: int, timeout: float = 60.0):
         values = self._exchange(
-            f"p2p:{src_rank}->{self.rank}", None, timeout
+            f"p2p:{src_rank}->{self.rank}",
+            None,
+            timeout,
+            participants=[src_rank, self.rank],
         )
         return values[src_rank]
 
