@@ -84,10 +84,21 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int,
         ]
-        for name in ("rts_pin", "rts_unpin", "rts_delete"):
-            fn = getattr(lib, name)
-            fn.restype = ctypes.c_int
-            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_pin.restype = ctypes.c_int64
+        lib.rts_pin.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rts_unpin_idx.restype = ctypes.c_int
+        lib.rts_unpin_idx.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rts_reap_dead_pins.restype = ctypes.c_int
+        lib.rts_reap_dead_pins.argtypes = [ctypes.c_void_p]
+        lib.rts_untracked_pins.restype = ctypes.c_uint64
+        lib.rts_untracked_pins.argtypes = [ctypes.c_void_p]
+        lib.rts_delete.restype = ctypes.c_int
+        lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rts_stats.restype = ctypes.c_int
         lib.rts_stats.argtypes = [
             ctypes.c_void_p,
@@ -169,6 +180,8 @@ class NativeArena:
             raise KeyError(f"seal({oid.hex()}) -> {rc}")
 
     def get(self, oid: bytes, sealed_only: bool = True):
+        if self._closed:
+            return None
         size = ctypes.c_uint64(0)
         offset = self._lib.rts_lookup(
             self._handle,
@@ -185,13 +198,45 @@ class NativeArena:
     def contains(self, oid: bytes) -> bool:
         return self.get(oid) is not None
 
-    def pin(self, oid: bytes) -> None:
-        self._lib.rts_pin(self._handle, self._key(oid))
+    def try_pin(self, oid: bytes):
+        """Atomically pin the sealed slot holding `oid` and return
+        (slot_index, zero-copy view) — or None if absent/unsealed.
+        Offset and size come back from the same critical section as
+        the pin, so the view always maps the pinned slot (a separate
+        lookup could race with delete + re-create of the oid)."""
+        if self._closed:
+            return None
+        offset = ctypes.c_uint64(0)
+        size = ctypes.c_uint64(0)
+        index = self._lib.rts_pin(
+            self._handle,
+            self._key(oid),
+            ctypes.byref(offset),
+            ctypes.byref(size),
+        )
+        if index < 0:
+            return None
+        n = int(size.value)
+        return int(index), self._view(int(offset.value), max(n, 1))[:n]
 
-    def unpin(self, oid: bytes) -> None:
-        self._lib.rts_unpin(self._handle, self._key(oid))
+    def unpin_idx(self, index: int) -> None:
+        # Reader-pin finalizers can outlive close() (weakref.finalize on
+        # fetched values fires at GC time); touching the unmapped arena
+        # then would segfault.
+        if self._closed:
+            return
+        self._lib.rts_unpin_idx(self._handle, index)
+
+    def reap_dead_pins(self) -> int:
+        """Release pins whose owning process has died (plasma's
+        disconnect-reclaim analog); returns pins reclaimed."""
+        if self._closed:
+            return 0
+        return int(self._lib.rts_reap_dead_pins(self._handle))
 
     def delete(self, oid: bytes) -> bool:
+        if self._closed:
+            return False
         return (
             self._lib.rts_delete(self._handle, self._key(oid)) == RTS_OK
         )
@@ -210,6 +255,9 @@ class NativeArena:
             "capacity": capacity.value,
             "used": used.value,
             "num_objects": num.value,
+            "untracked_pins": int(
+                self._lib.rts_untracked_pins(self._handle)
+            ),
         }
 
     def close(self, unlink: bool = False) -> None:

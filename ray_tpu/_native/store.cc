@@ -14,6 +14,7 @@
 // ctypes over a stable C surface is the supported binding path).
 
 #include <cerrno>
+#include <csignal>
 #include <cstdint>
 #include <cstring>
 
@@ -25,14 +26,23 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5254535052455631ULL;  // "RTSTOREV1"
+constexpr uint64_t kMagic = 0x5254535052455632ULL;  // "RTSTOREV2"
 constexpr uint32_t kOidBytes = 20;
 constexpr uint32_t kAlign = 64;
+// Distinct live reader pids tracked per slot; a pin beyond this is
+// still taken (reader safety first) but lands in untracked_pins and
+// cannot be crash-reclaimed, so keep headroom above the typical
+// workers-per-host concurrency on one hot object.
+constexpr uint32_t kPinRecsPerSlot = 4;
 
 enum SlotState : uint32_t {
   kFree = 0,
   kCreating = 1,
   kSealed = 2,
+  // Deleted while readers still hold pins: invisible to lookups, the
+  // range is freed when the last pin drops (plasma defers free to the
+  // last client Release the same way).
+  kDoomed = 3,
 };
 
 struct Slot {
@@ -53,6 +63,16 @@ struct FreeNode {
   int32_t in_use;
 };
 
+// Per-(process, slot) pin accounting so a crashed reader's pins can be
+// reclaimed (plasma reclaims a dead client's refs when its socket
+// drops; the serverless arena uses pid liveness instead).
+struct PinRec {
+  int32_t pid;
+  int32_t slot;
+  uint32_t count;
+  uint32_t in_use;
+};
+
 struct Header {
   uint64_t magic;
   uint64_t capacity;       // data heap bytes
@@ -61,9 +81,14 @@ struct Header {
   uint32_t num_slots;
   uint32_t num_free_nodes;
   int32_t free_head;       // free-list head (node index)
+  uint32_t num_pin_recs;
   uint32_t initialized;
+  uint32_t _pad;
+  // Pins taken while a slot's ledger bucket was full (>kPinRecsPerSlot
+  // distinct live pids on one slot): safe but not crash-reclaimable.
+  uint64_t untracked_pins;
   pthread_mutex_t mutex;
-  // Slot table and node pool follow; data heap after that.
+  // Slot table, node pool, and pin ledger follow; data heap after.
 };
 
 struct Handle {
@@ -73,13 +98,18 @@ struct Handle {
   Header* header;
   Slot* slots;
   FreeNode* nodes;
+  PinRec* pins;
   uint8_t* heap;
 };
 
 uint64_t AlignUp(uint64_t v, uint64_t a) { return (v + a - 1) / a * a; }
 
 Slot* FindSlot(Handle* h, const uint8_t* oid) {
-  // Linear probe from the oid's hash position.
+  // Linear probe from the oid's hash position. Doomed slots are
+  // invisible by oid: a deleted-while-pinned object must not block
+  // re-creation of the same (immutable) object id by lineage
+  // reconstruction — the doomed slot is reachable only through the
+  // pin ledger's slot index until its last pin drops.
   uint64_t hash = 1469598103934665603ULL;
   for (uint32_t i = 0; i < kOidBytes; ++i) {
     hash = (hash ^ oid[i]) * 1099511628211ULL;
@@ -87,12 +117,60 @@ Slot* FindSlot(Handle* h, const uint8_t* oid) {
   const uint32_t n = h->header->num_slots;
   for (uint32_t probe = 0; probe < n; ++probe) {
     Slot* slot = &h->slots[(hash + probe) % n];
-    if (slot->state != kFree &&
+    if (slot->state != kFree && slot->state != kDoomed &&
         memcmp(slot->oid, oid, kOidBytes) == 0) {
       return slot;
     }
   }
   return nullptr;
+}
+
+void DeleteSlotLocked(Handle* h, Slot* slot);
+
+// Ledger helpers (call with the arena mutex held). Recs are bucketed:
+// slot i owns indices [i*kPinRecsPerSlot, (i+1)*kPinRecsPerSlot), so
+// pin/unpin touch O(kPinRecsPerSlot) entries, not the whole ledger.
+PinRec* FindPinRec(Handle* h, int32_t pid, int32_t slot) {
+  for (uint32_t k = 0; k < kPinRecsPerSlot; ++k) {
+    PinRec* rec = &h->pins[slot * kPinRecsPerSlot + k];
+    if (rec->in_use && rec->pid == pid) return rec;
+  }
+  return nullptr;
+}
+
+// Reclaim bucket entries owned by dead pids (without freeing the slot
+// itself — callers handle doomed-slot cleanup).
+void ReapBucketLocked(Handle* h, int32_t slot_index) {
+  Slot* slot = &h->slots[slot_index];
+  for (uint32_t k = 0; k < kPinRecsPerSlot; ++k) {
+    PinRec* rec = &h->pins[slot_index * kPinRecsPerSlot + k];
+    if (rec->in_use && kill(rec->pid, 0) != 0 && errno == ESRCH) {
+      slot->pins =
+          (slot->pins > rec->count) ? slot->pins - rec->count : 0;
+      rec->in_use = 0;
+    }
+  }
+}
+
+PinRec* AllocPinRec(Handle* h, int32_t slot) {
+  for (uint32_t k = 0; k < kPinRecsPerSlot; ++k) {
+    PinRec* rec = &h->pins[slot * kPinRecsPerSlot + k];
+    if (!rec->in_use) return rec;
+  }
+  // Bucket full: entries may belong to dead pids — reap and retry so
+  // OOM-killed readers can't permanently exhaust a slot's bucket.
+  ReapBucketLocked(h, slot);
+  for (uint32_t k = 0; k < kPinRecsPerSlot; ++k) {
+    PinRec* rec = &h->pins[slot * kPinRecsPerSlot + k];
+    if (!rec->in_use) return rec;
+  }
+  return nullptr;
+}
+
+void FreeDoomedIfUnpinned(Handle* h, Slot* slot) {
+  if (slot->state == kDoomed && slot->pins == 0) {
+    DeleteSlotLocked(h, slot);
+  }
 }
 
 Slot* FindEmptySlot(Handle* h, const uint8_t* oid) {
@@ -242,9 +320,11 @@ extern "C" {
 void* rts_open(const char* path, uint64_t capacity, uint32_t num_slots,
                int create) {
   const uint64_t node_pool = num_slots;  // one free node per slot
+  const uint64_t pin_pool = num_slots * kPinRecsPerSlot;
   const uint64_t meta_size =
       AlignUp(sizeof(Header) + num_slots * sizeof(Slot) +
-                  node_pool * sizeof(FreeNode),
+                  node_pool * sizeof(FreeNode) +
+                  pin_pool * sizeof(PinRec),
               kAlign);
   const uint64_t total = meta_size + capacity;
   int fd = open(path, create ? (O_RDWR | O_CREAT) : O_RDWR, 0600);
@@ -274,6 +354,9 @@ void* rts_open(const char* path, uint64_t capacity, uint32_t num_slots,
   h->slots = reinterpret_cast<Slot*>(map + sizeof(Header));
   h->nodes = reinterpret_cast<FreeNode*>(
       map + sizeof(Header) + num_slots * sizeof(Slot));
+  h->pins = reinterpret_cast<PinRec*>(
+      map + sizeof(Header) + num_slots * sizeof(Slot) +
+      node_pool * sizeof(FreeNode));
   h->heap = map + meta_size;
   if (create && h->header->initialized != 1) {
     Header* hd = h->header;
@@ -284,6 +367,7 @@ void* rts_open(const char* path, uint64_t capacity, uint32_t num_slots,
     hd->lru_clock = 0;
     hd->num_slots = num_slots;
     hd->num_free_nodes = static_cast<uint32_t>(node_pool);
+    hd->num_pin_recs = static_cast<uint32_t>(pin_pool);
     pthread_mutexattr_t attr;
     pthread_mutexattr_init(&attr);
     pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
@@ -366,22 +450,91 @@ int64_t rts_lookup(void* handle, const uint8_t* oid, uint64_t* size_out,
   return static_cast<int64_t>(slot->offset);
 }
 
-int rts_pin(void* handle, const uint8_t* oid) {
+// Atomically pin the SEALED slot holding `oid` and report its
+// offset/size under one critical section — the caller must build its
+// view from these, never from a separate lookup, or a concurrent
+// delete + re-create of the same oid could hand it an unpinned slot's
+// memory (ABA). Returns the slot index (>=0) for rts_unpin_idx,
+// RTS_ERR_MISSING if absent/doomed, RTS_ERR_STATE if not yet sealed.
+// The ledger records (pid, slot, count) so rts_reap_dead_pins can
+// reclaim pins of crashed readers.
+int64_t rts_pin(void* handle, const uint8_t* oid, uint64_t* offset_out,
+                uint64_t* size_out) {
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h);
   Slot* slot = FindSlot(h, oid);
   if (slot == nullptr) return RTS_ERR_MISSING;
+  if (slot->state != kSealed) return RTS_ERR_STATE;
+  int32_t index = static_cast<int32_t>(slot - h->slots);
+  int32_t pid = static_cast<int32_t>(getpid());
+  PinRec* rec = FindPinRec(h, pid, index);
+  if (rec == nullptr) rec = AllocPinRec(h, index);
+  if (rec != nullptr) {
+    if (!rec->in_use) {
+      rec->in_use = 1;
+      rec->pid = pid;
+      rec->slot = index;
+      rec->count = 0;
+    }
+    rec->count += 1;
+  } else {
+    // Bucket exhaustion: still pin (reader safety beats reclaim).
+    h->header->untracked_pins += 1;
+  }
   slot->pins += 1;
+  slot->lru_tick = ++h->header->lru_clock;
+  *offset_out = slot->offset;
+  *size_out = slot->size;
+  return index;
+}
+
+int rts_unpin_idx(void* handle, int32_t index) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  if (index < 0 ||
+      static_cast<uint32_t>(index) >= h->header->num_slots) {
+    return RTS_ERR_MISSING;
+  }
+  Slot* slot = &h->slots[index];
+  if (slot->state == kFree) return RTS_ERR_MISSING;
+  PinRec* rec = FindPinRec(h, static_cast<int32_t>(getpid()), index);
+  if (rec != nullptr) {
+    rec->count -= 1;
+    if (rec->count == 0) rec->in_use = 0;
+  }
+  if (slot->pins > 0) slot->pins -= 1;
+  FreeDoomedIfUnpinned(h, slot);
   return RTS_OK;
 }
 
-int rts_unpin(void* handle, const uint8_t* oid) {
+// Reclaim pins held by processes that no longer exist. Returns the
+// number of pins reclaimed. Intended for the node daemon's periodic
+// maintenance tick (and before surfacing an arena-full error).
+int rts_reap_dead_pins(void* handle) {
   Handle* h = static_cast<Handle*>(handle);
   Locker lock(h);
-  Slot* slot = FindSlot(h, oid);
-  if (slot == nullptr) return RTS_ERR_MISSING;
-  if (slot->pins > 0) slot->pins -= 1;
-  return RTS_OK;
+  int reclaimed = 0;
+  for (uint32_t i = 0; i < h->header->num_pin_recs; ++i) {
+    PinRec* rec = &h->pins[i];
+    if (!rec->in_use) continue;
+    if (kill(rec->pid, 0) != 0 && errno == ESRCH) {
+      Slot* slot = &h->slots[i / kPinRecsPerSlot];
+      uint32_t n = rec->count;
+      if (slot->state != kFree) {
+        slot->pins = (slot->pins > n) ? slot->pins - n : 0;
+        FreeDoomedIfUnpinned(h, slot);
+      }
+      reclaimed += static_cast<int>(n);
+      rec->in_use = 0;
+    }
+  }
+  return reclaimed;
+}
+
+uint64_t rts_untracked_pins(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  return h->header->untracked_pins;
 }
 
 int rts_delete(void* handle, const uint8_t* oid) {
@@ -389,6 +542,14 @@ int rts_delete(void* handle, const uint8_t* oid) {
   Locker lock(h);
   Slot* slot = FindSlot(h, oid);
   if (slot == nullptr) return RTS_ERR_MISSING;
+  if (slot->pins > 0) {
+    // Readers still mapped: defer the free to the last unpin so their
+    // zero-copy views stay valid (delete-while-mapped safety). The
+    // doomed slot is invisible to FindSlot, so the oid can be
+    // re-created immediately.
+    slot->state = kDoomed;
+    return RTS_OK;
+  }
   DeleteSlotLocked(h, slot);
   return RTS_OK;
 }

@@ -423,6 +423,15 @@ class NodeDaemon:
             except Exception:
                 if self._shutdown:
                     return
+            # Reclaim arena reader pins of crashed/OOM-killed workers so
+            # their slots become evictable again (plasma reclaims on
+            # client disconnect; the serverless arena uses pid liveness).
+            reap = getattr(self.store, "reap_dead_pins", None)
+            if reap is not None:
+                try:
+                    reap()
+                except Exception:
+                    pass
             time.sleep(self.config.heartbeat_interval_s)
 
     def _h_disconnect(self, conn: Connection, msg: dict):
@@ -698,6 +707,18 @@ class NodeDaemon:
         with self._lock:
             entry = self.objects.get(oid)
             size = entry.size if entry is not None and entry.in_shm else None
+        if getattr(self.store, "needs_release", False):
+            pin = self.store.acquire(oid, timeout=0.1)
+            if pin is None:
+                return {"missing": True}
+            try:
+                total = len(pin.view)
+                chunk = bytes(
+                    pin.view[offset : min(offset + length, total)]
+                )
+            finally:
+                pin.release()
+            return {"data": chunk, "total_size": total}
         view = self.store.get(oid, timeout=0.1)
         if view is None and size is not None:
             # Segment was created directly by a local worker process;
@@ -1866,19 +1887,49 @@ class NodeDaemon:
                     bundle_index=index,
                 )
             return False
+        committed = []
+        uncommitted = []
         for index, node in prepared:
-            self._bundle_call(
+            reply = self._bundle_call(
                 node,
                 "commit_bundle",
                 pg_id=entry.pg_id,
                 bundle_index=index,
             )
-            with self._lock:
-                entry.bundle_nodes[index] = node
+            if reply.get("ok"):
+                committed.append((index, node))
+            else:
+                # A commit that never lands (RPC loss between prepare
+                # and commit) must not let the head record the bundle
+                # as placed — the node would hold unformatted resources
+                # while tasks queue on {R}_group_{i}_{pg} forever.
+                # Reference: gcs_placement_group_manager.cc treats
+                # commit failure as placement failure and reschedules.
+                uncommitted.append((index, node))
         with self._lock:
+            # Committed bundles stay placed (their formatted resources
+            # exist and tasks may already be queued or running on
+            # them); releasing them here would spuriously fail those
+            # tasks. Only the prepared-but-uncommitted bundles are
+            # rolled back and retried.
+            for index, node in committed:
+                entry.bundle_nodes[index] = node
+        for index, node in uncommitted:
+            self._bundle_call(
+                node,
+                "release_bundle",
+                pg_id=entry.pg_id,
+                bundle_index=index,
+            )
+        with self._lock:
+            # _pg_mutex (held by our caller) serializes against
+            # remove_placement_group, so the state can't have become
+            # REMOVED since the check at the top of this method.
             if all(n is not None for n in entry.bundle_nodes):
                 entry.state = "CREATED"
-        return True
+            else:
+                entry.state = "RESCHEDULING"
+        return not uncommitted
 
     def _retry_pending_pgs(self) -> None:
         with self._lock:

@@ -24,6 +24,7 @@ the way into numpy / `jax.numpy.asarray`.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from collections import OrderedDict
@@ -278,6 +279,60 @@ class SharedMemoryStore:
                 self.delete(oid, unlink=unlink)
 
 
+class ArenaPin:
+    """A reader lease on one arena object (plasma buffer analog).
+
+    Holds the slot pinned — unevictable and undeletable — until
+    release(), which is idempotent and safe after arena close. The
+    worker ties release to the lifetime of the zero-copy buffers it
+    hands out (see _TrackedBuffer), matching plasma's Release-on-
+    buffer-destruction protocol."""
+
+    __slots__ = ("_arena", "view", "_index", "_released")
+
+    def __init__(self, arena, view: memoryview, index: int):
+        self._arena = arena
+        self.view = view
+        self._index = index
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._arena.unpin_idx(self._index)
+
+
+class _PinToken:
+    """Anchor object for a pin's finalizer: kept alive by every
+    _TrackedBuffer carved from the pinned object, so the pin drops
+    exactly when the last zero-copy view is garbage-collected."""
+
+    __slots__ = ("__weakref__",)
+
+
+class _TrackedBuffer:
+    """Buffer-protocol wrapper (PEP 688, Python >=3.12) around an
+    arena slice that keeps the owning pin's token alive. Consumers
+    that reference the buffer (np.frombuffer, memoryview) keep this
+    object — and hence the pin — alive; consumers that copy (bytes)
+    let it die and the pin releases immediately."""
+
+    __slots__ = ("_mv", "_token", "__weakref__")
+
+    def __init__(self, mv: memoryview, token: _PinToken):
+        self._mv = mv
+        self._token = token
+
+    def __buffer__(self, flags):
+        return self._mv.__buffer__(flags)
+
+
+# Pure-Python __buffer__ is only honored from Python 3.12 (PEP 688);
+# earlier interpreters must copy out of the arena instead of handing
+# out views whose pin lifetime couldn't be tracked.
+TRACKED_BUFFERS_SUPPORTED = sys.version_info >= (3, 12)
+
+
 class NativeArenaStore:
     """Same surface as SharedMemoryStore over the C++ arena
     (_native/store.cc): one mmap'd /dev/shm file per node, first-fit
@@ -286,12 +341,14 @@ class NativeArenaStore:
     used/capacity), unlike the per-process bookkeeping above.
 
     Enabled via config `use_native_object_store` (RT_use_native_object_
-    store=1). Note the plasma-style caveat: the arena reuses freed
-    ranges immediately, so deletion of an object while another process
-    still holds a zero-copy view is unsafe — the daemon only deletes
-    refcount-zero objects, which is the same contract plasma's release
-    protocol enforces.
+    store=1). Readers are protected plasma-style: acquire() pins the
+    slot (pins block LRU eviction and defer deletion in store.cc) and
+    the returned ArenaPin releases when its zero-copy views die.
+    Crashed readers' pins are reclaimed by the daemon's periodic
+    reap_dead_pins() (plasma reclaims on client disconnect).
     """
+
+    needs_release = True  # consumers must use acquire()/ArenaPin
 
     def __init__(self, node_id_hex: str, capacity: int, on_evict=None):
         from .._native import NativeArena
@@ -338,12 +395,23 @@ class NativeArenaStore:
     def contains(self, object_id: ObjectID) -> bool:
         return self._arena.contains(object_id.binary())
 
-    def get(
+    def _try_acquire(self, object_id: ObjectID) -> Optional[ArenaPin]:
+        """Atomic pin+view (store.cc rts_pin) so the returned view is
+        guaranteed to map the pinned slot — immune both to concurrent
+        eviction and to delete/re-create ABA on the same oid."""
+        pinned = self._arena.try_pin(object_id.binary())
+        if pinned is None:
+            return None
+        index, view = pinned
+        return ArenaPin(self._arena, view, index)
+
+    def acquire(
         self, object_id: ObjectID, timeout: Optional[float] = None
-    ) -> Optional[memoryview]:
-        view = self._arena.get(object_id.binary())
-        if view is not None:
-            return view
+    ) -> Optional[ArenaPin]:
+        """Pinned zero-copy read lease; None if not sealed in time."""
+        pin = self._try_acquire(object_id)
+        if pin is not None:
+            return pin
         deadline = None if timeout is None else time.time() + timeout
         with self._lock:
             event = self._seal_events.setdefault(
@@ -359,9 +427,9 @@ class NativeArenaStore:
                 # Same-process seals signal the event; cross-process
                 # seals are observed by polling the shared index.
                 event.wait(timeout=min(remaining or 0.005, 0.005))
-                view = self._arena.get(object_id.binary())
-                if view is not None:
-                    return view
+                pin = self._try_acquire(object_id)
+                if pin is not None:
+                    return pin
         finally:
             # Cross-process seals never pop the event in seal(); drop
             # it here so long-lived consumers don't accumulate one
@@ -369,20 +437,8 @@ class NativeArenaStore:
             with self._lock:
                 self._seal_events.pop(object_id, None)
 
-    def open_remote(self, object_id: ObjectID, size: int) -> memoryview:
-        view = self._arena.get(object_id.binary())
-        if view is None:
-            raise FileNotFoundError(
-                f"object {object_id.hex()} not in arena"
-            )
-        return view
-
-    # -- lifetime ------------------------------------------------------
-    def pin(self, object_id: ObjectID) -> None:
-        self._arena.pin(object_id.binary())
-
-    def unpin(self, object_id: ObjectID) -> None:
-        self._arena.unpin(object_id.binary())
+    def reap_dead_pins(self) -> int:
+        return self._arena.reap_dead_pins()
 
     def unlink_by_id(self, object_id: ObjectID) -> None:
         self._arena.delete(object_id.binary())

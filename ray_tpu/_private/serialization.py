@@ -101,7 +101,13 @@ class SerializationContext:
             inband=inband, buffers=[b.raw() for b in buffers]
         )
 
-    def deserialize(self, data: memoryview | bytes) -> Any:
+    def deserialize(
+        self, data: memoryview | bytes, buffer_wrap=None
+    ) -> Any:
+        """Reconstruct a value; out-of-band buffers are zero-copy views
+        into `data`. `buffer_wrap(mv) -> buffer` lets the caller wrap
+        each out-of-band slice in a lifetime-tracking object (the
+        native arena ties reader pins to buffer lifetime this way)."""
         view = memoryview(data)
         (header_len,) = struct.unpack_from(">Q", view, 0)
         header = pickle.loads(bytes(view[8 : 8 + header_len]))
@@ -109,6 +115,9 @@ class SerializationContext:
         buffers = []
         for nbytes in header["nbytes"]:
             cursor = _align_up(cursor)
-            buffers.append(view[cursor : cursor + nbytes])
+            chunk = view[cursor : cursor + nbytes]
+            buffers.append(
+                chunk if buffer_wrap is None else buffer_wrap(chunk)
+            )
             cursor += nbytes
         return pickle.loads(header["inband"], buffers=buffers)

@@ -214,6 +214,7 @@ class CoreWorker:
         return out
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        deadline = None if timeout is None else time.time() + timeout
         try:
             reply = self._client.call(
                 "get_object", oid=oid.binary(), timeout=timeout
@@ -229,12 +230,85 @@ class CoreWorker:
         if reply.get("inline") is not None:
             return self.serialization.deserialize(reply["inline"])
         size = reply["shm_size"]
-        view = self.store.get(oid, timeout=0.001)
-        if view is None:
-            view = self.store.open_remote(oid, size)
         # Sealed objects are immutable (plasma semantics): readers get
         # read-only views, so zero-copy numpy arrays can't corrupt them.
-        return self.serialization.deserialize(view[:size].toreadonly())
+        if not getattr(self.store, "needs_release", False):
+            view = self.store.get(oid, timeout=0.001)
+            if view is None:
+                view = self.store.open_remote(oid, size)
+            return self.serialization.deserialize(view[:size].toreadonly())
+        # Native arena: acquire() pins the slot. The pin must outlive
+        # every zero-copy buffer carved from it — not just the fetched
+        # container — so each out-of-band buffer is wrapped in a
+        # _TrackedBuffer holding a shared token whose finalizer drops
+        # the pin (plasma ties Release to buffer destruction the same
+        # way). Values with no out-of-band buffers release immediately.
+        import weakref
+
+        from .object_store import (
+            TRACKED_BUFFERS_SUPPORTED,
+            _PinToken,
+            _TrackedBuffer,
+        )
+
+        pin = self._acquire_arena_pin(oid, deadline)
+        token = _PinToken()
+        wrapped = 0
+
+        def wrap(mv: memoryview):
+            if not TRACKED_BUFFERS_SUPPORTED:
+                # Pre-3.12: no PEP 688, so pin lifetime can't follow
+                # the buffer — copy out of the arena (correct, not
+                # zero-copy) and let the pin release immediately.
+                return bytes(mv)
+            nonlocal wrapped
+            wrapped += 1
+            return _TrackedBuffer(mv, token)
+
+        try:
+            value = self.serialization.deserialize(
+                pin.view[:size].toreadonly(), buffer_wrap=wrap
+            )
+        except BaseException:
+            pin.release()
+            raise
+        if wrapped:
+            weakref.finalize(token, pin.release)
+        else:
+            pin.release()
+        return value
+
+    def _acquire_arena_pin(self, oid: ObjectID, deadline: Optional[float]):
+        """Wait for `oid` to be sealed in the local arena, respecting
+        the caller's get() deadline (shared with the daemon RPC, not
+        granted afresh). With no deadline, block like the get()
+        contract demands — but re-ask the daemon periodically so an
+        eviction mid-wait triggers re-pull/reconstruction rather than
+        a silent hang."""
+        while True:
+            remaining = (
+                None if deadline is None else deadline - time.time()
+            )
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {oid}"
+                )
+            slice_t = 5.0 if remaining is None else min(remaining, 5.0)
+            pin = self.store.acquire(oid, timeout=slice_t)
+            if pin is not None:
+                return pin
+            # Not local yet: nudge the daemon (re-pulls lost copies,
+            # kicks lineage reconstruction if every copy died).
+            try:
+                self._client.call(
+                    "get_object", oid=oid.binary(), timeout=remaining
+                )
+            except RpcError as e:
+                if "__timeout__" in str(e):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid}"
+                    ) from None
+                raise
 
     def wait(
         self,

@@ -41,22 +41,35 @@ class ActorPool:
         return bool(self._future_to_actor) or bool(self._pending)
 
     def get_next(self, timeout: float = None) -> Any:
-        """Next result in submission order."""
+        """Next result in submission order.
+
+        The actor is returned to the idle pool *before* the result is
+        fetched (reference: python/ray/util/actor_pool.py:304) so that a
+        task exception does not shrink the pool; a timeout while waiting
+        leaves the pool state intact so the call can be retried.
+        """
         if not self.has_next():
             raise StopIteration("no pending results")
         if self._next_return not in self._index_to_future:
             raise ValueError(
                 "next ordered result was already consumed unordered"
             )
-        ref = self._index_to_future.pop(self._next_return)
+        ref = self._index_to_future[self._next_return]
+        ready, _ = self._rt.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("next ordered result not ready in time")
+        del self._index_to_future[self._next_return]
         self._next_return += 1
-        value = self._rt.get(ref, timeout=timeout)
         self._idle.append(self._future_to_actor.pop(ref))
         self._drain_pending()
-        return value
+        return self._rt.get(ref)
 
     def get_next_unordered(self, timeout: float = None) -> Any:
-        """Whichever pending result finishes first."""
+        """Whichever pending result finishes first.
+
+        Like get_next, the actor goes idle before the (possibly raising)
+        get, so failed tasks don't permanently remove actors.
+        """
         if not self.has_next():
             raise StopIteration("no pending results")
         refs = list(self._future_to_actor)
@@ -68,10 +81,9 @@ class ActorPool:
             if future is ref:
                 del self._index_to_future[index]
                 break
-        value = self._rt.get(ref, timeout=timeout)
         self._idle.append(self._future_to_actor.pop(ref))
         self._drain_pending()
-        return value
+        return self._rt.get(ref)
 
     def map(self, fn: Callable, values: Iterable[Any]):
         for value in values:

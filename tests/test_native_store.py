@@ -81,13 +81,16 @@ def test_lru_eviction_returns_victims(arena):
 
 def test_pinned_objects_survive_eviction(arena):
     quarter = (1 << 18) - 1024
+    indices = {}
     for i in range(4):
         buf, _ = arena.create(_oid(300 + i), quarter)
         arena.seal(_oid(300 + i))
-        arena.pin(_oid(300 + i))
+        pinned = arena.try_pin(_oid(300 + i))
+        assert pinned is not None
+        indices[i] = pinned[0]
     with pytest.raises(MemoryError):
         arena.create(_oid(400), quarter)
-    arena.unpin(_oid(300))
+    arena.unpin_idx(indices[0])
     _, evicted = arena.create(_oid(400), quarter)
     assert evicted == [_oid(300)]
 
@@ -183,3 +186,136 @@ def test_numpy_zero_copy_alignment(arena):
     # 64-byte aligned payloads reinterpret in place.
     back = np.frombuffer(view, dtype=np.float64)
     np.testing.assert_array_equal(back, arr)
+
+
+def test_delete_deferred_while_pinned(arena):
+    """delete() with live reader pins must not free the range (the
+    reader's zero-copy view would be silently overwritten); the free
+    happens at the last unpin, and the object is invisible meanwhile."""
+    payload = os.urandom(4096)
+    buf, _ = arena.create(_oid(9), len(payload))
+    buf[:] = payload
+    arena.seal(_oid(9))
+    pinned = arena.try_pin(_oid(9))
+    assert pinned is not None
+    pin_idx, view = pinned
+    objs_before = arena.stats()["num_objects"]
+    arena.delete(_oid(9))
+    # Doomed: invisible to new readers, not yet freed.
+    assert arena.get(_oid(9)) is None
+    assert arena.try_pin(_oid(9)) is None
+    assert bytes(view) == payload  # old view still intact
+    # A new allocation must not reuse the pinned range.
+    buf2, _ = arena.create(_oid(10), 4096)
+    buf2[:] = b"\xaa" * 4096
+    arena.seal(_oid(10))
+    assert bytes(view) == payload
+    # The doomed slot must not block re-creating the same oid (lineage
+    # reconstruction re-puts deleted objects).
+    buf3, _ = arena.create(_oid(9), 128)
+    buf3[:] = b"\xcc" * 128
+    arena.seal(_oid(9))
+    assert bytes(arena.get(_oid(9))) == b"\xcc" * 128
+    assert bytes(view) == payload  # still the old bytes
+    arena.delete(_oid(9))
+    view.release()
+    arena.unpin_idx(pin_idx)  # last pin drops -> doomed slot freed
+    assert arena.stats()["num_objects"] <= objs_before
+
+
+def test_get_pins_against_eviction(tmp_path):
+    """get() returns a pinned view: creates that would evict the object
+    pick another victim (or fail) while the view is held."""
+    from ray_tpu._native import NativeArena
+
+    store = NativeArena(str(tmp_path / "a2"), capacity=1 << 16,
+                        num_slots=64)
+    try:
+        first = os.urandom(1 << 14)
+        buf, _ = store.create(_oid(20), len(first))
+        buf[:] = first
+        store.seal(_oid(20))
+        pinned = store.try_pin(_oid(20))
+        assert pinned is not None
+        pin_idx, view = pinned
+        # Fill the arena: evictions must skip the pinned object.
+        for i in range(21, 40):
+            try:
+                b, _ = store.create(_oid(i), 1 << 13)
+            except MemoryError:
+                break
+            b[:] = b"\xbb" * (1 << 13)
+            store.seal(_oid(i))
+        assert bytes(view) == first
+        view.release()
+        store.unpin_idx(pin_idx)
+    finally:
+        store.close(unlink=True)
+
+
+def _pin_and_die(p, oid):
+    from ray_tpu._native import NativeArena as NA
+
+    s = NA(p, capacity=1 << 20, num_slots=256, create=False)
+    s.try_pin(oid)
+    os.kill(os.getpid(), 9)  # die without unpinning
+
+
+def test_dead_process_pins_reaped(tmp_path):
+    """Pins held by a SIGKILLed reader are reclaimed by
+    reap_dead_pins so the slot becomes evictable/deletable again."""
+    from ray_tpu._native import NativeArena
+
+    path = str(tmp_path / "a3")
+    store = NativeArena(path, capacity=1 << 20, num_slots=256)
+    try:
+        buf, _ = store.create(_oid(50), 1024)
+        buf[:] = b"\xdd" * 1024
+        store.seal(_oid(50))
+
+        proc = multiprocessing.get_context("spawn").Process(
+            target=_pin_and_die, args=(path, _oid(50))
+        )
+        proc.start()
+        proc.join(timeout=30)
+        # Object is pinned by a dead pid: delete defers to kDoomed.
+        store.delete(_oid(50))
+        assert store.get(_oid(50)) is None
+        before = store.stats()["num_objects"]
+        assert store.reap_dead_pins() >= 1
+        assert store.stats()["num_objects"] == before - 1
+    finally:
+        store.close(unlink=True)
+
+
+def test_zero_copy_value_keeps_pin_until_buffers_die(tmp_path):
+    """End-to-end: a numpy array fetched zero-copy from the native
+    store stays valid even when the store deletes the object and new
+    objects are created — the reader pin follows the buffer."""
+    import gc
+
+    import ray_tpu as rt
+
+    rt.init(
+        num_cpus=2,
+        _system_config={
+            "use_native_object_store": True,
+            # Small store so reuse-after-free would be observable.
+            "object_store_memory": 8 * 1024 * 1024,
+        },
+    )
+    try:
+        src = np.arange(250_000, dtype=np.float64)  # ~2MB, > inline
+        ref = rt.put(src)
+        arr = rt.get(ref, timeout=30)
+        np.testing.assert_array_equal(arr, src)
+        del ref  # refcount zero -> daemon deletes the object
+        # Churn the store: without the pin these creates could reuse
+        # the freed range and corrupt `arr`.
+        for i in range(6):
+            rt.get(rt.put(np.full(250_000, i, dtype=np.float64)),
+                   timeout=30)
+        gc.collect()
+        np.testing.assert_array_equal(arr, src)
+    finally:
+        rt.shutdown()
