@@ -20,6 +20,16 @@ _ENV_PREFIX = "RT_"
 
 @dataclass
 class Config:
+    # ---- transport (reference: gRPC over DCN; node_manager_port etc.
+    # in ray_config_def.h / services.py) ----
+    #: When set, every daemon additionally binds a TCP listener on this
+    #: host (port ephemeral unless node_listen_port is set) and
+    #: advertises tcp://host:port cluster-wide instead of its Unix
+    #: socket — required for real multi-host deployments.
+    node_listen_host: str = ""
+    #: Fixed TCP port for the daemon listener (0 = ephemeral).
+    node_listen_port: int = 0
+
     # ---- object store ----
     #: Objects at or below this size are passed inline in task
     #: specs/replies instead of the shared-memory store (reference:
@@ -36,9 +46,10 @@ class Config:
     #: Seconds between object-store eviction scans.
     object_eviction_check_interval_s: float = 1.0
     #: Use the native C++ arena store (_native/store.cc) instead of
-    #: per-object Python shm segments. Default off this round: the
-    #: arena reuses freed ranges immediately, so it requires the
-    #: refcount-gated deletion contract end to end.
+    #: per-object Python shm segments. Reader safety is plasma-style:
+    #: atomic pin+view on get, pin-deferred deletion, and dead-reader
+    #: pin reaping (see NativeArenaStore). Default off pending
+    #: bake-in as the jax.Array donation path.
     use_native_object_store: bool = False
 
     # ---- memory monitor (reference: memory_monitor.h:52, threshold

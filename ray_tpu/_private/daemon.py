@@ -145,7 +145,15 @@ class NodeDaemon:
         is_head: bool = True,
         head_address: Optional[str] = None,
         labels: Optional[Dict[str, str]] = None,
+        listen_host: Optional[str] = None,
+        listen_port: int = 0,
     ):
+        """A per-host daemon (raylet analog). Local workers always ride
+        the session Unix socket; passing `listen_host` additionally
+        binds a TCP listener (DCN transport) whose address is what the
+        node advertises cluster-wide — the configuration for real
+        multi-host deployments (reference: raylet's gRPC
+        NodeManagerService port, node_manager.proto:406)."""
         self.session_dir = session_dir
         self.config = config
         self.is_head = is_head
@@ -211,6 +219,14 @@ class NodeDaemon:
         self._hb_thread: Optional[threading.Thread] = None
 
         self.server = RpcServer(self.socket_path)
+        listen_host = listen_host or config.node_listen_host or None
+        if listen_host:
+            self.address = self.server.add_listener(
+                f"tcp://{listen_host}:"
+                f"{listen_port or config.node_listen_port}"
+            )
+        else:
+            self.address = self.socket_path
         for name in [
             "register_client",
             "kv_put",
@@ -275,7 +291,7 @@ class NodeDaemon:
             self.control.register_node(
                 NodeInfo(
                     node_id=self.node_id,
-                    address=self.socket_path,
+                    address=self.address,
                     resources=dict(resources),
                     labels=self.labels,
                     is_head=True,
@@ -303,7 +319,7 @@ class NodeDaemon:
             self.head.call(
                 "register_node",
                 node_id=self.node_id.binary(),
-                address=self.socket_path,
+                address=self.address,
                 resources=self.resources,
                 labels=self.labels,
             )

@@ -60,7 +60,7 @@ class Session:
                 labels=labels,
             )
             self.daemon.start()
-            address = self.daemon.socket_path
+            address = self.daemon.socket_path  # driver rides the local Unix socket
         self.address = address
         self.worker = CoreWorker(address, role="driver")
         set_global_worker(self.worker)

