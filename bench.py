@@ -1,21 +1,87 @@
-"""Benchmark: Llama training-step throughput + MFU on one TPU chip.
+"""Benchmark entrypoint: Llama training MFU on the TPU chip + runtime
+op/s microbenchmarks.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line on the LAST stdout line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-The reference's north star (BASELINE.md) is Llama-2-7B pretraining at
->=45% MFU on a v5e-256 pod; a 7B model does not fit one 16-GiB v5e
-chip, so the single-chip benchmark uses a 410M-param Llama with the
-same architecture/kernels (Pallas flash attention, remat+scan layers,
-bf16, fused AdamW step) and reports MFU — the hardware-normalized
-metric the north star is defined in. vs_baseline = achieved_MFU / 0.45.
+Design (round-1 verdict weak #1: the bench must tolerate a held/slow
+chip — the axon TPU backend can hang in init for minutes):
+
+- The MFU measurement runs in a SUBPROCESS (``--mode tpu``) with a hard
+  timeout and retries with backoff; a hung backend init can never hang
+  the bench itself.
+- Before touching the chip, stale TPU-holding processes from prior
+  test runs (worker_main leftovers) are reaped and the libtpu lockfile
+  cleared.
+- If the chip never comes up, a CPU subprocess (``--mode cpu``) runs
+  the same training step on a tiny config so the bench still emits its
+  JSON line, marked ``"cpu_fallback": true``.
+- A ray_perf-style op/s microbenchmark suite (verdict item 6; model:
+  reference python/ray/_private/ray_perf.py:120-288) always runs on
+  the distributed runtime (CPU-bound by design) and is embedded under
+  the ``"micro"`` key and written to MICROBENCH.json.
+
+North star (BASELINE.md): Llama-2-7B >=45% MFU on a v5e-256 pod. A 7B
+model does not fit one 16-GiB v5e chip, so the single-chip benchmark
+uses a 410M-param Llama with the same architecture/kernels (Pallas
+flash attention, remat+scan layers, bf16, fused AdamW) and reports
+MFU — the hardware-normalized metric. vs_baseline = MFU / 0.45.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+# First compile can take minutes; overridable for tests
+# (RT_BENCH_TPU_TIMEOUTS="5,5").
+TPU_ATTEMPT_TIMEOUTS = tuple(
+    float(t)
+    for t in os.environ.get("RT_BENCH_TPU_TIMEOUTS", "420,300").split(",")
+)
+TPU_RETRY_SLEEP = float(os.environ.get("RT_BENCH_TPU_RETRY_SLEEP", "15"))
+
+
+# ---------------------------------------------------------------------------
+# chip hygiene
+# ---------------------------------------------------------------------------
+
+def reap_stale_tpu_holders() -> int:
+    """Kill leftover ray_tpu worker processes from prior runs — a
+    SIGKILLed test session can leave a worker holding the TPU, which
+    makes every later backend init hang (observed >550s)."""
+    me = os.getpid()
+    killed = 0
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="ignore")
+        except OSError:
+            continue
+        if "ray_tpu._private.worker_main" in cmd:
+            try:
+                os.kill(int(pid), 9)
+                killed += 1
+            except OSError:
+                pass
+    for lockfile in ("/tmp/libtpu_lockfile",):
+        try:
+            os.remove(lockfile)
+        except OSError:
+            pass
+    return killed
+
+
+# ---------------------------------------------------------------------------
+# the measured workload (runs inside the mode subprocesses)
+# ---------------------------------------------------------------------------
 
 def peak_flops_per_chip() -> float:
     """bf16 peak FLOP/s for the local accelerator generation."""
@@ -33,9 +99,8 @@ def peak_flops_per_chip() -> float:
     return 1.97e14  # conservative default
 
 
-def main() -> None:
+def run_train_bench(tpu: bool) -> dict:
     import jax
-    import jax.numpy as jnp
 
     from ray_tpu.models.llama import (
         LlamaConfig,
@@ -51,12 +116,13 @@ def main() -> None:
         shard_batch,
     )
 
-    on_tpu = jax.default_backend() not in ("cpu", "gpu")
-    if on_tpu:
+    if tpu:
+        backend = jax.default_backend()
+        assert backend not in ("cpu", "gpu"), f"not a TPU backend: {backend}"
         cfg = LlamaConfig.bench_410m()
         batch, seq = 8, 2048
         steps, warmup = 20, 3
-    else:  # CI fallback so the bench always emits a line
+    else:
         cfg = LlamaConfig.tiny()
         batch, seq = 4, 128
         steps, warmup = 3, 1
@@ -97,19 +163,251 @@ def main() -> None:
         flops_per_token(cfg, seq) * tokens_per_sec_chip
         / peak_flops_per_chip()
     )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"llama_{cfg.num_params() // 1_000_000}M_train_"
-                    f"tokens_per_sec_per_chip"
-                ),
-                "value": round(tokens_per_sec_chip, 1),
-                "unit": f"tokens/s/chip (MFU={mfu:.3f}, step={dt*1e3:.0f}ms)",
-                "vs_baseline": round(mfu / 0.45, 4),
-            }
+    return {
+        "metric": (
+            f"llama_{cfg.num_params() // 1_000_000}M_train_"
+            f"tokens_per_sec_per_chip"
+        ),
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": f"tokens/s/chip (MFU={mfu:.3f}, step={dt*1e3:.0f}ms)",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }
+
+
+# ---------------------------------------------------------------------------
+# op/s microbenchmarks (reference: ray_perf.py cases)
+# ---------------------------------------------------------------------------
+
+def _timeit(fn, n: int) -> float:
+    """ops/sec of fn() called n times (fn performs one op)."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return n / (time.perf_counter() - t0)
+
+
+def run_micro() -> dict:
+    import numpy as np
+
+    import ray_tpu as rt
+
+    results: dict = {}
+    # 8 CPUs: the suite holds up to 6 live actors (1 latency counter,
+    # 4 n:n actors, 1 DAG echo) plus task workers.
+    rt.init(num_cpus=8)
+    try:
+        @rt.remote
+        def nop():
+            return None
+
+        @rt.remote
+        def small_arg(x):
+            return x
+
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        # warm the worker pool
+        rt.get([nop.remote() for _ in range(8)], timeout=60)
+
+        # 1. sequential task round-trips (submit+get latency)
+        results["task_roundtrip_per_s"] = round(_timeit(
+            lambda: rt.get(nop.remote(), timeout=30), 50
+        ), 1)
+
+        # 2. pipelined task throughput
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(500)]
+        rt.get(refs, timeout=120)
+        results["task_throughput_per_s"] = round(
+            500 / (time.perf_counter() - t0), 1
         )
+
+        # 3. tasks with a small inline arg
+        payload = b"x" * 1024
+        t0 = time.perf_counter()
+        rt.get([small_arg.remote(payload) for _ in range(300)], timeout=120)
+        results["task_1kb_arg_per_s"] = round(
+            300 / (time.perf_counter() - t0), 1
+        )
+
+        # 4. actor: sequential calls (1:1 latency)
+        counter = Counter.remote()
+        rt.get(counter.inc.remote(), timeout=30)
+        results["actor_call_roundtrip_per_s"] = round(_timeit(
+            lambda: rt.get(counter.inc.remote(), timeout=30), 100
+        ), 1)
+
+        # 5. actor: pipelined calls
+        t0 = time.perf_counter()
+        rt.get([counter.inc.remote() for _ in range(500)], timeout=120)
+        results["actor_call_throughput_per_s"] = round(
+            500 / (time.perf_counter() - t0), 1
+        )
+
+        # 6. n:n actor calls (4 actors, pipelined)
+        actors = [Counter.remote() for _ in range(4)]
+        rt.get([a.inc.remote() for a in actors], timeout=60)
+        t0 = time.perf_counter()
+        rt.get(
+            [a.inc.remote() for _ in range(125) for a in actors],
+            timeout=120,
+        )
+        results["actor_nn_calls_per_s"] = round(
+            500 / (time.perf_counter() - t0), 1
+        )
+
+        # 7. put/get small (inline path)
+        small = b"y" * (10 * 1024)
+        results["put_get_10kb_per_s"] = round(_timeit(
+            lambda: rt.get(rt.put(small), timeout=30), 200
+        ), 1)
+
+        # 8. put/get large (shared-memory path) -> GB/s
+        big = np.random.default_rng(0).random(8_000_000)  # 64 MB
+        t0 = time.perf_counter()
+        for _ in range(5):
+            ref = rt.put(big)
+            out = rt.get(ref, timeout=60)
+            del ref, out
+        dt = (time.perf_counter() - t0) / 5
+        results["put_get_64mb_gbps"] = round(
+            big.nbytes / dt / 1e9, 2
+        )
+
+        # 9. compiled DAG hop (channel round-trip vs RPC)
+        from ray_tpu.dag import InputNode, experimental_compile
+
+        @rt.remote
+        class Echo:
+            def ping(self, x):
+                return x
+
+        echo = Echo.remote()
+        with InputNode() as inp:
+            dag = echo.ping.bind(inp)
+        compiled = experimental_compile(dag)
+        try:
+            compiled.execute(1).get(timeout=30)
+            results["dag_hop_per_s"] = round(_timeit(
+                lambda: compiled.execute(1).get(timeout=30), 200
+            ), 1)
+        finally:
+            compiled.teardown()
+    finally:
+        rt.shutdown()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def _run_mode_subprocess(mode: str, timeout: float) -> dict | None:
+    """Run `python bench.py --mode {tpu,cpu}` and parse its last stdout
+    line as JSON; None on timeout/crash."""
+    env = dict(os.environ)
+    if mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--mode", mode],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {mode} attempt timed out after {timeout}s",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        tail = (proc.stderr or "")[-2000:]
+        print(f"[bench] {mode} attempt rc={proc.returncode}: {tail}",
+              file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--mode",
+        choices=["orchestrate", "tpu", "cpu", "micro"],
+        default="orchestrate",
     )
+    parser.add_argument(
+        "--skip-micro", action="store_true",
+        help="omit the op/s microbenchmark suite",
+    )
+    args = parser.parse_args()
+
+    if args.mode == "tpu":
+        print(json.dumps(run_train_bench(tpu=True)))
+        return
+    if args.mode == "cpu":
+        result = run_train_bench(tpu=False)
+        result["cpu_fallback"] = True
+        result["vs_baseline"] = 0.0  # CPU numbers do not count vs 45% MFU
+        print(json.dumps(result))
+        return
+    if args.mode == "micro":
+        print(json.dumps(run_micro()))
+        return
+
+    # Orchestrate: hygiene -> TPU attempts -> CPU fallback; plus micro.
+    killed = reap_stale_tpu_holders()
+    if killed:
+        print(f"[bench] reaped {killed} stale worker process(es)",
+              file=sys.stderr)
+        time.sleep(2.0)
+
+    result = None
+    for attempt, budget in enumerate(TPU_ATTEMPT_TIMEOUTS):
+        result = _run_mode_subprocess("tpu", budget)
+        if result is not None:
+            break
+        if attempt + 1 < len(TPU_ATTEMPT_TIMEOUTS):
+            reap_stale_tpu_holders()
+            time.sleep(TPU_RETRY_SLEEP)
+    if result is None:
+        print("[bench] TPU unavailable; falling back to CPU",
+              file=sys.stderr)
+        result = _run_mode_subprocess("cpu", 600.0)
+    if result is None:  # even the CPU path died: emit an honest line
+        result = {
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": "both TPU and CPU benchmark subprocesses failed",
+        }
+
+    if not args.skip_micro:
+        try:
+            micro = run_micro()
+            result["micro"] = micro
+            with open(os.path.join(REPO, "MICROBENCH.json"), "w") as f:
+                json.dump(micro, f, indent=2)
+        except Exception as e:  # micro failure must not kill the line
+            result["micro_error"] = str(e)[:500]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -20,14 +20,20 @@ Addresses
 
 Wire format & authentication
 ----------------------------
-``[8-byte length][32-byte HMAC-SHA256][pickled dict]``. The HMAC is
-keyed by the cluster's session token (``auth_key``; default from
-``RT_AUTH_TOKEN``) and verified BEFORE unpickling — unauthenticated
-peers cannot reach the deserializer, which is what makes a pickle
-wire format tolerable on TCP (VERDICT weak #9). A frame that fails
-verification terminates the connection. Every message carries `_mid`
-(correlation id); server replies echo it; unsolicited pushes use
-`_mid = -1` and a `_push` channel name.
+On accept, the server sends a random 16-byte connection nonce
+(``[8-byte length][nonce]``); both sides derive the connection key
+``HMAC(cluster_key, b"rt-conn" || nonce)``. Every subsequent frame is
+``[8-byte length][32-byte HMAC-SHA256][pickled dict]`` keyed by the
+connection key and verified BEFORE unpickling — unauthenticated peers
+cannot reach the deserializer (which is what makes a pickle wire
+format tolerable on TCP, VERDICT weak #9), and a frame captured on
+one connection cannot be replayed on another (different nonce). A
+frame that fails verification terminates the connection. The cluster
+key comes from ``auth_key`` / ``RT_AUTH_TOKEN``; daemons refuse to
+bind TCP with the well-known local default (they auto-generate, see
+NodeDaemon). Every message carries `_mid` (correlation id); server
+replies echo it; unsolicited pushes use `_mid = -1` and a `_push`
+channel name.
 """
 
 from __future__ import annotations
@@ -53,12 +59,21 @@ _MAX_FRAME = int(os.environ.get("RT_RPC_MAX_FRAME", 1 << 28))  # 256 MiB
 
 def default_auth_key() -> bytes:
     """Cluster auth token: RT_AUTH_TOKEN env, else a well-known local
-    key — acceptable ONLY for single-host Unix-socket sessions. The
-    CLI generates and propagates a random token whenever it binds a
-    TCP listener (scripts/cli.py), and `Cluster(use_tcp=True)` test
-    clusters stay on loopback."""
+    key — acceptable ONLY for single-host Unix-socket sessions
+    (protected by session-dir file permissions). NodeDaemon refuses to
+    run a TCP listener on this default: it generates a random token
+    and exports it before binding (see daemon._ensure_tcp_auth)."""
     token = os.environ.get("RT_AUTH_TOKEN", "")
-    return token.encode() if token else b"rt-insecure-local-session"
+    return token.encode() if token else INSECURE_LOCAL_KEY
+
+
+INSECURE_LOCAL_KEY = b"rt-insecure-local-session"
+
+
+def _connection_key(cluster_key: bytes, nonce: bytes) -> bytes:
+    return _hmac.new(
+        cluster_key, b"rt-conn" + nonce, hashlib.sha256
+    ).digest()
 
 
 def parse_address(address: str) -> Union[Tuple[str, str], Tuple[str, str, int]]:
@@ -81,16 +96,36 @@ def _detect_host_ip() -> str:
     """Best-effort primary interface IP (the reference resolves node
     IPs the same way, services.py get_node_ip_address): route a UDP
     socket at a public address — no packets are sent — and read the
-    chosen source address."""
+    chosen source address. Falls back to the hostname's address; a
+    loopback result is advertised only with a loud warning since
+    remote peers cannot dial it."""
+    ip = None
     try:
         probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
             probe.connect(("8.8.8.8", 80))
-            return probe.getsockname()[0]
+            ip = probe.getsockname()[0]
         finally:
             probe.close()
     except OSError:
-        return "127.0.0.1"
+        pass
+    if ip is None or ip.startswith("127."):
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = None
+    if ip is None or ip.startswith("127."):
+        import sys
+
+        print(
+            "[ray_tpu] WARNING: could not determine a dialable host "
+            "IP for a wildcard TCP bind; advertising 127.0.0.1 — "
+            "remote nodes will NOT reach this daemon. Pass an "
+            "explicit --listen-host / listen_host.",
+            file=sys.stderr,
+        )
+        ip = "127.0.0.1"
+    return ip
 
 
 class RpcError(Exception):
@@ -326,11 +361,23 @@ class Connection:
         self._sock = sock
         self.conn_id = conn_id
         self._send_lock = threading.Lock()
+        self._key = server.auth_key  # replaced by the conn key in serve
         self.metadata: Dict[str, Any] = {}  # e.g. worker id after register
 
     def serve(self) -> None:
+        # Nonce handshake: frames on this connection are keyed by
+        # HMAC(cluster_key, nonce), so a frame recorded on another
+        # connection can't be replayed here.
+        nonce = os.urandom(16)
+        try:
+            with self._send_lock:
+                self._sock.sendall(_LEN.pack(len(nonce)) + nonce)
+        except OSError:
+            self._server._on_disconnect(self)
+            return
+        self._key = _connection_key(self._server.auth_key, nonce)
         while True:
-            msg = recv_msg(self._sock, self._server.auth_key)
+            msg = recv_msg(self._sock, self._key)
             if msg is None:
                 break
             self._server._dispatch(self, msg)
@@ -341,7 +388,7 @@ class Connection:
         payload["_mid"] = mid
         with self._send_lock:
             try:
-                send_msg(self._sock, payload, self._server.auth_key)
+                send_msg(self._sock, payload, self._key)
             except ConnectionLost:
                 pass
 
@@ -351,7 +398,7 @@ class Connection:
         payload["_push"] = channel
         with self._send_lock:
             try:
-                send_msg(self._sock, payload, self._server.auth_key)
+                send_msg(self._sock, payload, self._key)
             except ConnectionLost:
                 pass
 
