@@ -431,22 +431,38 @@ class RpcClient:
         self._parsed = parse_address(path)
         self.auth_key = auth_key or default_auth_key()
         self._push_handler = push_handler
-        self._sock = self._connect(connect_timeout)
         self._mid = 0
         self._lock = threading.Lock()
         # Serializes whole frames: call()/notify() run on arbitrary
         # threads (ObjectRef.__del__ fires on GC threads) and an
         # interleaved sendall would corrupt the length-prefixed wire.
+        # Also guards the (sock, conn_key) pair so a sender never mixes
+        # one connection's socket with another's key.
         self._send_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
         self._replies: Dict[int, dict] = {}
         self._closed = False
+        #: Bumped on every (re)connect; stale reader threads check it
+        #: before flushing waiters so a dead connection's teardown can't
+        #: fail calls issued on its replacement.
+        self._conn_gen = 0
+        sock, key = self._connect(connect_timeout)
+        #: Per-connection frame key, derived from the server's nonce in
+        #: _connect (mirrors Connection.serve). Replaced on _reconnect.
+        self._sock, self._conn_key = sock, key
+        self._start_reader(sock, key, self._conn_gen)
+
+    def _start_reader(self, sock, key, gen) -> None:
         self._reader = threading.Thread(
-            target=self._read_loop, name=f"rpc-client:{path}", daemon=True
+            target=self._read_loop,
+            args=(sock, key, gen),
+            name=f"rpc-client:{self._path}",
+            daemon=True,
         )
         self._reader.start()
 
-    def _connect(self, timeout: float) -> socket.socket:
+    def _connect(self, timeout: float) -> Tuple[socket.socket, bytes]:
         deadline = time.time() + timeout
         last_err: Exception | None = None
         while time.time() < deadline:
@@ -461,7 +477,25 @@ class RpcClient:
                 target = (self._parsed[1], self._parsed[2])
             try:
                 sock.connect(target)
-                return sock
+                # Client half of the nonce handshake (see module
+                # docstring / Connection.serve): read [8-byte len][nonce]
+                # and key every subsequent frame on this socket with
+                # HMAC(cluster_key, "rt-conn"||nonce).
+                prev_timeout = sock.gettimeout()
+                sock.settimeout(max(deadline - time.time(), 1.0))
+                header = _recv_exact(sock, _LEN.size)
+                if header is None:
+                    raise ConnectionResetError("no nonce from server")
+                (nlen,) = _LEN.unpack(header)
+                if nlen == 0 or nlen > 64:
+                    raise ConnectionResetError(
+                        f"bad nonce length {nlen} from server"
+                    )
+                nonce = _recv_exact(sock, nlen)
+                if nonce is None:
+                    raise ConnectionResetError("truncated nonce")
+                sock.settimeout(prev_timeout)
+                return sock, _connection_key(self.auth_key, nonce)
             except (
                 FileNotFoundError,
                 ConnectionRefusedError,
@@ -474,9 +508,9 @@ class RpcClient:
                 time.sleep(0.05)
         raise ConnectionLost(f"cannot connect to {self._path}: {last_err}")
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock, key, gen) -> None:
         while not self._closed:
-            msg = recv_msg(self._sock, self.auth_key)
+            msg = recv_msg(sock, key)
             if msg is None:
                 break
             mid = msg.get("_mid")
@@ -493,8 +527,12 @@ class RpcClient:
                     self._replies[mid] = msg
             if event is not None:
                 event.set()
-        # Connection lost: wake all waiters with an error.
+        # Connection lost: wake all waiters with an error — but only if
+        # this reader still owns the live connection; a stale reader
+        # must not fail calls issued on its replacement.
         with self._lock:
+            if gen != self._conn_gen:
+                return
             for mid, event in self._pending.items():
                 self._replies[mid] = {"_error": "__connection_lost__"}
                 event.set()
@@ -511,6 +549,8 @@ class RpcClient:
         attempt = 0
         backoff = 0.1
         while True:
+            with self._lock:
+                seen_gen = self._conn_gen
             if _chaos_should_fail(method):
                 reply = {"_error": "__chaos_injected_failure__"}
             else:
@@ -527,7 +567,7 @@ class RpcClient:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
                 if err == "__connection_lost__":
-                    self._reconnect()
+                    self._reconnect(seen_gen)
                 continue
             raise RpcError(f"{method}: {err}")
 
@@ -544,7 +584,7 @@ class RpcClient:
         msg["_mid"] = mid
         try:
             with self._send_lock:
-                send_msg(self._sock, msg, self.auth_key)
+                send_msg(self._sock, msg, self._conn_key)
         except ConnectionLost:
             with self._lock:
                 self._pending.pop(mid, None)
@@ -552,6 +592,9 @@ class RpcClient:
         if not event.wait(timeout=timeout):
             with self._lock:
                 self._pending.pop(mid, None)
+                # The reader may have raced the timeout and already
+                # moved the reply into _replies; drop it or it leaks.
+                self._replies.pop(mid, None)
             return {"_error": "__timeout__"}
         with self._lock:
             return self._replies.pop(mid)
@@ -563,20 +606,38 @@ class RpcClient:
         msg["_mid"] = 0
         try:
             with self._send_lock:
-                send_msg(self._sock, msg, self.auth_key)
+                send_msg(self._sock, msg, self._conn_key)
         except ConnectionLost:
             pass
 
-    def _reconnect(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        self._sock = self._connect(10.0)
-        self._reader = threading.Thread(
-            target=self._read_loop, daemon=True
-        )
-        self._reader.start()
+    def _reconnect(self, seen_gen: Optional[int] = None) -> None:
+        """Replace the connection. `seen_gen` is the generation the
+        caller observed failing; if another thread already reconnected
+        past it, this is a no-op (two racing retries produce one new
+        connection, not two)."""
+        with self._reconnect_lock:
+            with self._lock:
+                if seen_gen is not None and self._conn_gen != seen_gen:
+                    return  # somebody else already reconnected
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            sock, key = self._connect(10.0)
+            with self._send_lock:
+                self._sock, self._conn_key = sock, key
+            with self._lock:
+                self._conn_gen += 1
+                gen = self._conn_gen
+                # Calls still pending were sent on the dead connection
+                # and can never be answered on this one; fail them now
+                # rather than trusting the old reader's scheduling luck
+                # (its flush is skipped once the generation moves on).
+                for mid, event in self._pending.items():
+                    self._replies[mid] = {"_error": "__connection_lost__"}
+                    event.set()
+                self._pending.clear()
+            self._start_reader(sock, key, gen)
 
     def close(self) -> None:
         self._closed = True

