@@ -441,6 +441,11 @@ class RpcClient:
         self._send_lock = threading.Lock()
         self._reconnect_lock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
+        #: mid -> connection generation the request was SENT on (absent
+        #: until the send completes). Reconnect only fails mids sent on
+        #: an older generation; a request that slipped onto the new
+        #: socket (or hasn't been sent yet) must not be flushed.
+        self._pending_gen: Dict[int, int] = {}
         self._replies: Dict[int, dict] = {}
         self._closed = False
         #: Bumped on every (re)connect; stale reader threads check it
@@ -537,6 +542,7 @@ class RpcClient:
                 self._replies[mid] = {"_error": "__connection_lost__"}
                 event.set()
             self._pending.clear()
+            self._pending_gen.clear()
 
     def call(
         self,
@@ -585,18 +591,24 @@ class RpcClient:
         try:
             with self._send_lock:
                 send_msg(self._sock, msg, self._conn_key)
+                with self._lock:  # lock order: _send_lock then _lock
+                    if mid in self._pending:
+                        self._pending_gen[mid] = self._conn_gen
         except ConnectionLost:
             with self._lock:
                 self._pending.pop(mid, None)
+                self._pending_gen.pop(mid, None)
             return {"_error": "__connection_lost__"}
         if not event.wait(timeout=timeout):
             with self._lock:
                 self._pending.pop(mid, None)
+                self._pending_gen.pop(mid, None)
                 # The reader may have raced the timeout and already
                 # moved the reply into _replies; drop it or it leaks.
                 self._replies.pop(mid, None)
             return {"_error": "__timeout__"}
         with self._lock:
+            self._pending_gen.pop(mid, None)
             return self._replies.pop(mid)
 
     def notify(self, method: str, **kwargs) -> None:
@@ -617,6 +629,8 @@ class RpcClient:
         connection, not two)."""
         with self._reconnect_lock:
             with self._lock:
+                if self._closed:
+                    return
                 if seen_gen is not None and self._conn_gen != seen_gen:
                     return  # somebody else already reconnected
             try:
@@ -624,19 +638,34 @@ class RpcClient:
             except OSError:
                 pass
             sock, key = self._connect(10.0)
+            # Swap + generation bump + flush as one atomic step under
+            # _send_lock: senders record their send generation while
+            # holding it, so nothing can send during the swap and every
+            # pending mid has an accurate generation tag.
             with self._send_lock:
-                self._sock, self._conn_key = sock, key
-            with self._lock:
-                self._conn_gen += 1
-                gen = self._conn_gen
-                # Calls still pending were sent on the dead connection
-                # and can never be answered on this one; fail them now
-                # rather than trusting the old reader's scheduling luck
-                # (its flush is skipped once the generation moves on).
-                for mid, event in self._pending.items():
-                    self._replies[mid] = {"_error": "__connection_lost__"}
-                    event.set()
-                self._pending.clear()
+                with self._lock:
+                    if self._closed:  # close() raced the reconnect
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        return
+                    self._sock, self._conn_key = sock, key
+                    self._conn_gen += 1
+                    gen = self._conn_gen
+                    # Fail calls sent on a dead connection — they can
+                    # never be answered here. Unsent/new-gen mids stay.
+                    stale = [
+                        mid for mid, g in self._pending_gen.items()
+                        if g < gen and mid in self._pending
+                    ]
+                    for mid in stale:
+                        event = self._pending.pop(mid)
+                        self._pending_gen.pop(mid, None)
+                        self._replies[mid] = {
+                            "_error": "__connection_lost__"
+                        }
+                        event.set()
             self._start_reader(sock, key, gen)
 
     def close(self) -> None:

@@ -88,10 +88,15 @@ class LlamaConfig:
 
     @staticmethod
     def bench_410m(**kw) -> "LlamaConfig":
-        """GPT-medium-scale config for single-chip benchmarking."""
+        """GPT-medium-scale config for single-chip benchmarking.
+
+        TPU-shaped: head_dim=128 (8 heads) fills the 128-wide MXU
+        lanes and halves the softmax VPU work per attention FLOP vs
+        the GPT-medium-standard 16x64 split — same param count, same
+        flagship (Llama-7B-class) head geometry."""
         return LlamaConfig(
-            vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
-            n_kv_heads=16, intermediate=2816, max_seq_len=2048, **kw
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
+            n_kv_heads=8, intermediate=2816, max_seq_len=2048, **kw
         )
 
 

@@ -50,6 +50,24 @@ def _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len):
     return jnp.where(valid, s, DEFAULT_MASK_VALUE)
 
 
+def _block_needs_mask(qi, ki, block_q, block_k, causal, kv_len):
+    """Traced predicate: does this (qi, ki) tile need logit masking?
+    Returns None when masking is statically never needed, so callers
+    can skip the branch entirely. Interior tiles (strictly below the
+    causal diagonal, no KV padding) take the fast path — the
+    iota/compare/select VPU work is a measurable cost at small
+    head_dim where the VPU, not the MXU, limits the kernel."""
+    may_pad = kv_len % block_k != 0  # static
+    if causal:
+        on_diag = qi * block_q < ki * block_k + block_k - 1
+        if may_pad:
+            return on_diag | (ki * block_k + block_k > kv_len)
+        return on_diag
+    if may_pad:
+        return ki * block_k + block_k > kv_len
+    return None
+
+
 def mha_reference(
     q: jax.Array,
     k: jax.Array,
@@ -100,29 +118,51 @@ def _fwd_kernel(
     if causal:
         run = qi * block_q + block_q - 1 >= ki * block_k
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)  # [bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
-        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
-
+    def _update(s, v):
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [bq, bk]
+        p = jnp.exp(s - m_new)  # [bq, bk] f32
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(run)
+    def _compute():
+        # MXU dots stay in the input dtype (bf16) with f32 accumulation
+        # via preferred_element_type — upcasting operands to f32 first
+        # would run the matmuls at a fraction of the bf16 MXU rate.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk] f32
+
+        needs_mask = _block_needs_mask(
+            qi, ki, block_q, block_k, causal, kv_len
+        )
+        if needs_mask is None:
+            _update(s, v)
+        else:
+            @pl.when(needs_mask)
+            def _masked():
+                _update(
+                    _mask_logits(
+                        s, qi, ki, block_q, block_k, causal, kv_len
+                    ),
+                    v,
+                )
+
+            @pl.when(jnp.logical_not(needs_mask))
+            def _interior():
+                _update(s, v)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -199,27 +239,44 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][0][:, None]  # [bq, 1]
         delta = delta_ref[0][0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+
+        def _update(s):
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = (p * (dp - delta) * scale).astype(k.dtype)
+            acc_ref[:] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        needs_mask = _block_needs_mask(
+            qi, ki, block_q, block_k, causal, kv_len
         )
-        ds = p * (dp - delta) * scale
-        acc_ref[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if needs_mask is None:
+            _update(s)
+        else:
+            @pl.when(needs_mask)
+            def _masked():
+                _update(_mask_logits(
+                    s, qi, ki, block_q, block_k, causal, kv_len
+                ))
+
+            @pl.when(jnp.logical_not(needs_mask))
+            def _interior():
+                _update(s)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -247,36 +304,63 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][0][:, None]
         delta = delta_ref[0][0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len)
-        p = jnp.exp(s - lse)  # [bq, bk]
-        # Padded q rows (beyond q_len) must not contribute to dk/dv.
-        row_ids = jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        ) + qi * block_q
-        p = jnp.where(row_ids < q_len, p, 0.0)
-        dv_acc_ref[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+
+        def _update(p):
+            pb = p.astype(do.dtype)
+            dv_acc_ref[:] += jax.lax.dot_general(
+                pb, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+            dk_acc_ref[:] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        def _row_masked(p):
+            # Padded q rows (beyond q_len) must not contribute.
+            row_ids = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            ) + qi * block_q
+            return jnp.where(row_ids < q_len, p, 0.0)
+
+        nq_total = pl.num_programs(2)
+        needs_mask = _block_needs_mask(
+            qi, ki, block_q, block_k, causal, kv_len
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale  # [bq, bk]
-        dk_acc_ref[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        q_may_pad = q_len % block_q != 0  # static
+        if q_may_pad:
+            row_mask = qi == nq_total - 1
+            needs_mask = (
+                row_mask if needs_mask is None else needs_mask | row_mask
+            )
+        if needs_mask is None:
+            _update(jnp.exp(s - lse))
+        else:
+            @pl.when(needs_mask)
+            def _masked():
+                p = jnp.exp(_mask_logits(
+                    s, qi, ki, block_q, block_k, causal, kv_len
+                ) - lse)
+                _update(_row_masked(p) if q_may_pad else p)
+
+            @pl.when(jnp.logical_not(needs_mask))
+            def _interior():
+                _update(jnp.exp(s - lse))
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -287,6 +371,10 @@ def _bwd_dkv_kernel(
 def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k, kv_len, q_len):
     bh, t, d = q.shape
     tk = k.shape[1]
+    # The bwd kernels hold more f32 intermediates (s, p, dp, ds plus
+    # two accumulators) than the fwd; at block 1024x1024 with d=128
+    # they overflow the 16 MiB scoped-VMEM budget, so cap the q tile.
+    block_q = min(block_q, 512)
     nq = pl.cdiv(t, block_q)
     nk = pl.cdiv(tk, block_k)
     delta = jnp.sum(
@@ -398,8 +486,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
     force_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Fused attention: Pallas kernel on TPU, reference math elsewhere.

@@ -29,10 +29,11 @@ def test_init_roundtrip_is_fast():
         assert rt.get(f.remote(41)) == 42
         ref = rt.put({"k": [1, 2, 3]})
         assert rt.get(ref) == {"k": [1, 2, 3]}
-        elapsed = time.monotonic() - t0
-        # Generous bound (cold interpreter + worker spawn); the point
-        # is that a wedged handshake (which hangs forever) fails here
-        # in seconds instead of stalling the suite.
-        assert elapsed < 10.0, f"init+roundtrip took {elapsed:.1f}s"
+        # The timeout marker is the liveness gate: a wedged handshake
+        # (which hangs forever) fails here in 15s instead of stalling
+        # the suite. No wall-clock assert — cold caches on a loaded CI
+        # box can make a healthy init slow without anything being
+        # wedged.
+        print(f"init+roundtrip in {time.monotonic() - t0:.2f}s")
     finally:
         rt.shutdown()
