@@ -112,6 +112,17 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.c_int,
             ctypes.c_char_p,
         ]
+        try:  # added after v1 .so builds; staleness check rebuilds,
+            # but never let a stale binary break the whole store.
+            lib.rts_load_acq_u64.restype = ctypes.c_uint64
+            lib.rts_load_acq_u64.argtypes = [ctypes.c_void_p]
+            lib.rts_store_rel_u64.restype = None
+            lib.rts_store_rel_u64.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+            ]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -140,6 +151,13 @@ class NativeArena:
             lib.rts_base(self._handle), ctypes.c_void_p
         ).value
         self._closed = False
+        # Serializes native entry points against close(): a bare
+        # `_closed` flag check is a TOCTOU — close() unmapping the
+        # arena while another thread (daemon heartbeat reaper, RPC
+        # handler) is inside an rts_* call is a segfault. RLock, not
+        # Lock: unpin finalizers fire from GC at arbitrary points,
+        # including while the same thread holds the lock.
+        self._call_lock = threading.RLock()
 
     @staticmethod
     def _key(oid: bytes) -> bytes:
@@ -156,14 +174,17 @@ class NativeArena:
         """Returns (writable memoryview, [evicted oids])."""
         evicted = ctypes.create_string_buffer(OID_BYTES * 64)
         n_evicted = ctypes.c_int(0)
-        offset = self._lib.rts_create(
-            self._handle,
-            self._key(oid),
-            max(size, 1),
-            evicted,
-            64,
-            ctypes.byref(n_evicted),
-        )
+        with self._call_lock:
+            if self._closed:
+                raise MemoryError("arena closed")
+            offset = self._lib.rts_create(
+                self._handle,
+                self._key(oid),
+                max(size, 1),
+                evicted,
+                64,
+                ctypes.byref(n_evicted),
+            )
         if offset == RTS_ERR_EXISTS:
             raise ValueError(f"object {oid.hex()} already exists")
         if offset < 0:
@@ -175,20 +196,24 @@ class NativeArena:
         return self._view(offset, max(size, 1))[:size], ids
 
     def seal(self, oid: bytes) -> None:
-        rc = self._lib.rts_seal(self._handle, self._key(oid))
+        with self._call_lock:
+            if self._closed:
+                raise KeyError("arena closed")
+            rc = self._lib.rts_seal(self._handle, self._key(oid))
         if rc != RTS_OK:
             raise KeyError(f"seal({oid.hex()}) -> {rc}")
 
     def get(self, oid: bytes, sealed_only: bool = True):
-        if self._closed:
-            return None
         size = ctypes.c_uint64(0)
-        offset = self._lib.rts_lookup(
-            self._handle,
-            self._key(oid),
-            ctypes.byref(size),
-            1 if sealed_only else 0,
-        )
+        with self._call_lock:
+            if self._closed:
+                return None
+            offset = self._lib.rts_lookup(
+                self._handle,
+                self._key(oid),
+                ctypes.byref(size),
+                1 if sealed_only else 0,
+            )
         if offset < 0:
             return None
         return self._view(offset, max(int(size.value), 1))[
@@ -204,66 +229,80 @@ class NativeArena:
         Offset and size come back from the same critical section as
         the pin, so the view always maps the pinned slot (a separate
         lookup could race with delete + re-create of the oid)."""
-        if self._closed:
-            return None
         offset = ctypes.c_uint64(0)
         size = ctypes.c_uint64(0)
-        index = self._lib.rts_pin(
-            self._handle,
-            self._key(oid),
-            ctypes.byref(offset),
-            ctypes.byref(size),
-        )
-        if index < 0:
-            return None
-        n = int(size.value)
-        return int(index), self._view(int(offset.value), max(n, 1))[:n]
+        with self._call_lock:
+            if self._closed:
+                return None
+            index = self._lib.rts_pin(
+                self._handle,
+                self._key(oid),
+                ctypes.byref(offset),
+                ctypes.byref(size),
+            )
+            if index < 0:
+                return None
+            n = int(size.value)
+            return (
+                int(index),
+                self._view(int(offset.value), max(n, 1))[:n],
+            )
 
     def unpin_idx(self, index: int) -> None:
         # Reader-pin finalizers can outlive close() (weakref.finalize on
         # fetched values fires at GC time); touching the unmapped arena
         # then would segfault.
-        if self._closed:
-            return
-        self._lib.rts_unpin_idx(self._handle, index)
+        with self._call_lock:
+            if self._closed:
+                return
+            self._lib.rts_unpin_idx(self._handle, index)
 
     def reap_dead_pins(self) -> int:
         """Release pins whose owning process has died (plasma's
         disconnect-reclaim analog); returns pins reclaimed."""
-        if self._closed:
-            return 0
-        return int(self._lib.rts_reap_dead_pins(self._handle))
+        with self._call_lock:
+            if self._closed:
+                return 0
+            return int(self._lib.rts_reap_dead_pins(self._handle))
 
     def delete(self, oid: bytes) -> bool:
-        if self._closed:
-            return False
-        return (
-            self._lib.rts_delete(self._handle, self._key(oid)) == RTS_OK
-        )
+        with self._call_lock:
+            if self._closed:
+                return False
+            return (
+                self._lib.rts_delete(self._handle, self._key(oid))
+                == RTS_OK
+            )
 
     def stats(self) -> dict:
         capacity = ctypes.c_uint64(0)
         used = ctypes.c_uint64(0)
         num = ctypes.c_uint64(0)
-        self._lib.rts_stats(
-            self._handle,
-            ctypes.byref(capacity),
-            ctypes.byref(used),
-            ctypes.byref(num),
-        )
+        with self._call_lock:
+            if self._closed:
+                return {
+                    "capacity": 0, "used": 0, "num_objects": 0,
+                    "untracked_pins": 0,
+                }
+            self._lib.rts_stats(
+                self._handle,
+                ctypes.byref(capacity),
+                ctypes.byref(used),
+                ctypes.byref(num),
+            )
+            untracked = int(self._lib.rts_untracked_pins(self._handle))
         return {
             "capacity": capacity.value,
             "used": used.value,
             "num_objects": num.value,
-            "untracked_pins": int(
-                self._lib.rts_untracked_pins(self._handle)
-            ),
+            "untracked_pins": untracked,
         }
 
     def close(self, unlink: bool = False) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self._lib.rts_close(
-            self._handle, 1 if unlink else 0, self._path
-        )
+        with self._call_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._lib.rts_close(
+                self._handle, 1 if unlink else 0, self._path
+            )

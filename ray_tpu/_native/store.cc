@@ -576,4 +576,17 @@ void rts_close(void* handle, int unlink_file, const char* path) {
   delete h;
 }
 
+// Cross-process atomic accessors for shared-memory ring buffers
+// (dag/channels.py): acquire/release orderings make the
+// payload-then-counter publication pattern correct on any
+// architecture, not just x86-TSO.
+uint64_t rts_load_acq_u64(const void* p) {
+  return __atomic_load_n(static_cast<const uint64_t*>(p),
+                         __ATOMIC_ACQUIRE);
+}
+
+void rts_store_rel_u64(void* p, uint64_t v) {
+  __atomic_store_n(static_cast<uint64_t*>(p), v, __ATOMIC_RELEASE);
+}
+
 }  // extern "C"

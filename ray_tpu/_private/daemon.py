@@ -101,6 +101,11 @@ class WorkerInfo:
     is_tpu: bool = False
     pinned_actor: Optional[ActorID] = None
     current_task: Optional[TaskID] = None
+    #: Direct-transport endpoint served by the worker process
+    #: (reference: the worker's gRPC server in core_worker.h).
+    direct_address: Optional[str] = None
+    #: conn_id of the driver holding this worker via request_lease.
+    leased_by: Optional[int] = None
 
 
 @dataclass
@@ -182,6 +187,16 @@ class NodeDaemon:
         self._spawn_failures = 0
         self._shutdown = False
         self._worker_procs: List[subprocess.Popen] = []
+        # Direct-transport leases: lease_id -> (worker_conn_id,
+        # driver_conn_id). The worker is out of the shared pool while
+        # leased; its resources stay reserved in the scheduler under
+        # the lease id (reference: raylet worker leases,
+        # node_manager.cc:1807 HandleRequestWorkerLease).
+        self.leases: Dict[str, tuple] = {}
+        self._lease_counter = 0
+        # actor_id -> [(conn, mid)] waiting for the actor's direct
+        # address (replied when the actor becomes ALIVE or DEAD).
+        self._actor_addr_waiters: Dict[ActorID, list] = {}
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -282,6 +297,11 @@ class NodeDaemon:
             "actor_task",
             "kill_actor_local",
             "cancel_local",
+            # direct task transport (placement-only daemon role)
+            "request_lease",
+            "release_lease",
+            "actor_address",
+            "task_event",
         ]:
             self.server.register(name, getattr(self, "_h_" + name))
         self.server.register("_disconnect", self._h_disconnect)
@@ -340,6 +360,7 @@ class NodeDaemon:
                 worker_id=WorkerID.from_random(),
                 pid=msg["pid"],
                 is_tpu=bool(msg.get("is_tpu", False)),
+                direct_address=msg.get("direct_address"),
             )
             with self._lock:
                 self.workers[conn.conn_id] = info
@@ -463,10 +484,26 @@ class NodeDaemon:
             self._on_node_death(dead_node)
             return {}
         if winfo is None:
+            self._release_driver_leases(conn.conn_id)
             return {}
         # Worker died (reference: raylet detects worker death via the
         # socket, node_manager.cc:1089 publishes WorkerDeltaData).
-        if winfo.pinned_actor is not None:
+        if winfo.leased_by is not None:
+            # Leased worker died: free the lease's reservation; the
+            # driver sees its direct connection break and handles
+            # retry/failure submitter-side.
+            with self._lock:
+                lease_ids = [
+                    lid
+                    for lid, (wc, _) in self.leases.items()
+                    if wc == conn.conn_id
+                ]
+            for lid in lease_ids:
+                with self._lock:
+                    self.leases.pop(lid, None)
+                self.scheduler.release(lid)
+            self._schedule()
+        elif winfo.pinned_actor is not None:
             self._on_actor_worker_death(winfo)
         elif winfo.current_task is not None:
             self._on_task_worker_death(winfo)
@@ -474,6 +511,151 @@ class NodeDaemon:
 
     def _h_ping(self, conn, msg):
         return {"ok": True, "node_id": self.node_id.binary()}
+
+    # ------------------------------------------------------------------
+    # direct task transport: worker leases + actor addresses
+    # (reference: node_manager.cc:1807 HandleRequestWorkerLease;
+    # the submitter-side protocol lives in _private/direct.py)
+    # ------------------------------------------------------------------
+    def _h_request_lease(self, conn, msg):
+        """Lease an idle local worker to a driver. Queued through the
+        LocalScheduler as a pseudo-task so resource accounting and
+        FIFO fairness are shared with daemon-scheduled work."""
+        if not self.is_head:
+            # Drivers attach to the head (enforced at register); a
+            # lease request reaching a worker node is out of contract.
+            return {"unavailable": True}
+        resources = dict(msg.get("resources") or {})
+        request = ResourceSet(resources)
+        if not request.fits_in(self.scheduler.total()):
+            # Locally infeasible (possibly transiently, under PG
+            # reservations): the daemon path owns placement then.
+            return {"unavailable": True}
+        with self._lock:
+            self._lease_counter += 1
+            lease_id = f"lease:{self._lease_counter}"
+        spec = {
+            "kind": "lease",
+            "resources": resources,
+            "needs_tpu": bool(msg.get("needs_tpu")),
+            "_conn": conn,
+            "_mid": msg["_mid"],
+            "_driver": conn.conn_id,
+            "_lease_id": lease_id,
+        }
+        # In a multi-node cluster an unserved lease must fail fast so
+        # the driver's daemon path can spill the work to other nodes;
+        # single-node it waits (workers free up or spawn).
+        multinode = (
+            self.is_head
+            and self.control is not None
+            and len(self.control.nodes) > 1
+        )
+        if multinode:
+            spec["_deadline"] = time.time() + 1.0
+            timer = threading.Timer(1.1, self._expire_lease_requests)
+            timer.daemon = True
+            timer.start()
+        self.scheduler.enqueue(lease_id, request, spec)
+        self._schedule()
+        return DEFERRED
+
+    def _expire_lease_requests(self) -> None:
+        now = time.time()
+        expired = self.scheduler.drain_queued(
+            lambda s: s.get("kind") == "lease"
+            and s.get("_deadline") is not None
+            and s["_deadline"] < now
+        )
+        for spec in expired:
+            spec["_conn"].reply(spec["_mid"], {"unavailable": True})
+
+    def _h_release_lease(self, conn, msg):
+        self._release_lease(msg["lease_id"])
+        return {}
+
+    def _release_lease(self, lease_id: str) -> None:
+        with self._lock:
+            entry = self.leases.pop(lease_id, None)
+            if entry is None:
+                return
+            worker_conn_id, _ = entry
+            worker = self.workers.get(worker_conn_id)
+            if worker is not None:
+                worker.leased_by = None
+                worker.current_task = None
+                worker.idle = True
+        self.scheduler.release(lease_id)
+        self._schedule()
+
+    def _release_driver_leases(self, driver_conn_id: int) -> None:
+        """Driver disconnected: return its leased workers and drop its
+        queued lease requests."""
+        with self._lock:
+            held = [
+                lid for lid, (_, drv) in self.leases.items()
+                if drv == driver_conn_id
+            ]
+        for lid in held:
+            self._release_lease(lid)
+        dropped = self.scheduler.drain_queued(
+            lambda s: s.get("kind") == "lease"
+            and s.get("_driver") == driver_conn_id
+        )
+        if dropped:
+            self._schedule()
+
+    def _h_actor_address(self, conn, msg):
+        """Resolve an actor's direct endpoint; defers until the actor
+        leaves PENDING/RESTARTING. Empty reply = use the daemon path
+        (remote node, dead, or no direct endpoint)."""
+        if not self.is_head:
+            # Never proxy: the head defers this reply until the actor
+            # is ALIVE, and a blocking head.call here would wedge this
+            # connection's dispatch thread (all RPC from that client)
+            # behind actor creation. Drivers attach to the head, so a
+            # request here is out of contract — daemon path.
+            return {}
+        actor_id = ActorID(msg["actor_id"])
+        with self._lock:
+            runtime = self.actor_runtimes.get(actor_id)
+            if runtime is None or runtime.info.state == ACTOR_DEAD:
+                return {}
+            if runtime.info.state == ACTOR_ALIVE:
+                return self._actor_address_reply(actor_id, runtime)
+            self._actor_addr_waiters.setdefault(actor_id, []).append(
+                (conn, msg["_mid"])
+            )
+        return DEFERRED
+
+    def _actor_address_reply(self, actor_id, runtime) -> dict:
+        """Caller holds the lock. ALIVE actor -> direct address if it
+        is hosted by a local worker with an endpoint."""
+        if runtime.node != self.node_id.binary():
+            return {}
+        host = self.actor_hosts.get(actor_id)
+        if host is None or host.worker_conn_id is None:
+            return {}
+        worker = self.workers.get(host.worker_conn_id)
+        if worker is None or not worker.direct_address:
+            return {}
+        return {
+            "address": worker.direct_address,
+            "worker_id": worker.worker_id.binary(),
+        }
+
+    def _wake_actor_addr_waiters(self, actor_id: ActorID) -> None:
+        with self._lock:
+            waiters = self._actor_addr_waiters.pop(actor_id, [])
+            if not waiters:
+                return
+            runtime = self.actor_runtimes.get(actor_id)
+            if runtime is None or runtime.info.state != ACTOR_ALIVE:
+                reply = {}
+            else:
+                reply = self._actor_address_reply(actor_id, runtime)
+        for conn, mid in waiters:
+            conn.reply(mid, reply)
 
     # ------------------------------------------------------------------
     # node clients (head->node forwards, node->node pulls)
@@ -1499,6 +1681,7 @@ class NodeDaemon:
                         break
                     spec = runtime.pending.popleft()
                 self._route_actor_task(runtime, spec)
+        self._wake_actor_addr_waiters(actor_id)
         return {}
 
     def _kill_host_worker(self, actor_id: ActorID, node_id: bytes) -> None:
@@ -1612,6 +1795,7 @@ class NodeDaemon:
         self._unpin_creation_args(runtime)
         for p in pending + inflight:
             self._fail_task_returns(p, "ActorDiedError", cause)
+        self._wake_actor_addr_waiters(actor_id)
 
     def _h_kill_actor(self, conn, msg):
         if not self.is_head:
@@ -2201,7 +2385,7 @@ class NodeDaemon:
     def _deps_ready(self, spec: dict) -> bool:
         missing = []
         with self._lock:
-            for kind, payload in spec["args"]:
+            for kind, payload in spec.get("args", ()):
                 if kind == "ref":
                     oid = ObjectID(payload)
                     entry = self.objects.get(oid)
@@ -2222,6 +2406,8 @@ class NodeDaemon:
 
     def _try_dispatch(self, task_id: TaskID, spec: dict) -> bool:
         needs_tpu = spec.get("resources", {}).get("TPU", 0) > 0
+        if spec["kind"] == "lease":
+            return self._try_grant_lease(task_id, spec, needs_tpu)
         with self._lock:
             worker = next(
                 (
@@ -2243,6 +2429,46 @@ class NodeDaemon:
                 worker.pinned_actor = ActorID(spec["actor_id"])
         self._record_task_event(spec, "RUNNING")
         worker.conn.push("execute_task", {"spec": spec})
+        return True
+
+    def _try_grant_lease(self, lease_id, spec: dict, needs_tpu: bool) -> bool:
+        """Dispatch callback for lease pseudo-tasks: hand an idle
+        worker (with a direct endpoint) to the requesting driver."""
+        with self._lock:
+            if spec["_driver"] not in self.drivers:
+                # Requesting driver disconnected while this request was
+                # queued (its lease sweep already ran): consume the
+                # request and free the reservation, or the worker
+                # would be marked leased to a ghost forever.
+                self.scheduler.release(lease_id)
+                return True
+            worker = next(
+                (
+                    w
+                    for w in self.workers.values()
+                    if w.idle
+                    and w.is_tpu == needs_tpu
+                    and w.direct_address
+                ),
+                None,
+            )
+            if worker is None:
+                if (
+                    len(self.workers) + self._spawning < self._max_workers
+                ):
+                    self._spawn_worker(needs_tpu)
+                return False
+            worker.idle = False
+            worker.current_task = lease_id
+            worker.leased_by = spec["_driver"]
+            self.leases[lease_id] = (worker.conn.conn_id, spec["_driver"])
+            worker_id = worker.worker_id.binary()
+            address = worker.direct_address
+        spec["_conn"].reply(
+            spec["_mid"],
+            {"lease_id": lease_id, "worker_id": worker_id,
+             "address": address},
+        )
         return True
 
     def _spawn_worker(self, needs_tpu: bool = False) -> None:
@@ -2321,7 +2547,16 @@ class NodeDaemon:
             ]
         for tid, spec in queued:
             if self.scheduler.cancel(tid):
-                self._fail_task_returns(spec, "WorkerCrashedError", detail)
+                if spec.get("kind") == "lease":
+                    # Lease pseudo-tasks have no returns; tell the
+                    # requesting driver to use the daemon path.
+                    spec["_conn"].reply(
+                        spec["_mid"], {"unavailable": True}
+                    )
+                else:
+                    self._fail_task_returns(
+                        spec, "WorkerCrashedError", detail
+                    )
 
     def _on_task_worker_death(self, winfo: WorkerInfo) -> None:
         task_id = winfo.current_task
@@ -2587,6 +2822,22 @@ class NodeDaemon:
                 out[name] = clean
         return {"metrics": out}
 
+    def _h_task_event(self, conn, msg):
+        """Workers report state events for direct-transport tasks
+        (the daemon never sees those specs; reference: workers batch
+        task events to the GCS task manager the same way)."""
+        if not self.config.task_events_enabled:
+            return {}
+        if not self.is_head:
+            try:
+                self.head.notify("task_event", events=msg["events"])
+            except RpcError:
+                pass
+            return {}
+        for event in msg["events"]:
+            self.control.add_task_event(event)
+        return {}
+
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:
             return
@@ -2607,6 +2858,11 @@ class NodeDaemon:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        # Stop the heartbeat/reaper thread before closing the store:
+        # its reap_dead_pins must not race the arena unmap.
+        hb = getattr(self, "_hb_thread", None)
+        if hb is not None and hb.is_alive():
+            hb.join(timeout=self.config.heartbeat_interval_s + 1.0)
         if self._memory_monitor is not None:
             self._memory_monitor.stop()
         for proc in self._worker_procs:

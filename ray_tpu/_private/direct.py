@@ -1,0 +1,716 @@
+"""Direct task transport: submitter-to-worker task push over leased
+workers, daemons only for placement.
+
+This is the TPU-native analog of the reference's direct task calls
+(reference: src/ray/core_worker/transport/normal_task_submitter.cc:23,
+83,141 — the submitter leases a worker per scheduling key from the
+raylet, then pushes task specs worker-to-worker with the raylet out of
+the data path; and actor_task_submitter.h — actor calls go straight to
+the actor's worker over an established connection).
+
+Architecture
+------------
+- Every worker process serves a tiny RPC endpoint (its *direct
+  address*, a Unix socket in the session dir). ``execute_task``
+  requests enqueue into the worker's single task loop and the reply —
+  carrying inline results — is deferred until execution finishes, so
+  per-connection ordering and single-threaded actor semantics are
+  preserved while requests pipeline in the socket.
+- For **normal tasks**, the driver holds leases per *scheduling key*
+  (resources + TPU-ness), granted by the daemon (``request_lease`` — a
+  pseudo-task through the LocalScheduler, so resource accounting and
+  fairness are shared with the daemon-scheduled path). The hot path
+  has NO dedicated threads: the submitting thread sends the spec with
+  ``RpcClient.call_async`` and the lease connection's reader thread
+  fulfills the result future and dispatches the next queued spec.
+  One background "requester" thread serves lease-pool growth, idle
+  release, and starvation sweeps off the critical path.
+- For **actor tasks**, one router thread per actor handle resolves the
+  actor's direct address once (blocking ``actor_address`` call that
+  the daemon answers when the actor is ALIVE) and then pushes calls
+  directly. Actors hosted off-node (or whose worker died) fall back
+  to the daemon path — *sticky*, so per-handle ordering is never
+  split across two transports in flight.
+- Results come back inline in the RPC reply (small) or as
+  ``("shm", size)`` markers after the worker seals them in the node's
+  shared store (large — the zero-copy path). The driver fulfills a
+  local future per return id; ``get``/``wait`` consult these futures
+  before asking the daemon.
+
+Tasks that need daemon machinery — placement groups, node affinity,
+runtime envs, TPU gangs — keep the daemon path (eligibility below).
+System failures (lease connection lost) retry submitter-side up to the
+task's ``max_retries``, matching the reference's handling of leased
+worker death.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .ids import ObjectID
+from .rpc import ConnectionLost, RpcClient, RpcError
+from .task_spec import make_error_payload
+
+#: In-flight request cap per leased connection. 1 = every task lands
+#: on an idle worker (no head-of-line blocking behind a slow task);
+#: queued backlog is re-dispatched from reply callbacks, which already
+#: pipelines the socket turnaround.
+_PIPELINE_CAP = 1
+
+
+class ResultFuture:
+    """One task's worth of direct results (all return ids)."""
+
+    __slots__ = (
+        "event", "results", "error", "daemon_fallback", "hold_refs",
+        "_cb_lock", "_callbacks",
+    )
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.results: Optional[List[tuple]] = None  # aligned w/ returns
+        self.error: Optional[bytes] = None
+        self.daemon_fallback = False
+        #: Submitter-side arg pinning: ObjectRef args stay referenced
+        #: until the task completes, or the daemon may delete a dep the
+        #: caller dropped while the worker still needs it (the daemon
+        #: path pins args in _pin_args; direct specs never transit it).
+        self.hold_refs: Optional[list] = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: List = []
+
+    def fulfill(self, results: Optional[List[tuple]], error: Optional[bytes]):
+        self.results = results
+        self.error = error
+        self.hold_refs = None
+        self._finish()
+
+    def to_daemon(self):
+        self.daemon_fallback = True
+        self._finish()
+
+    def _finish(self) -> None:
+        with self._cb_lock:
+            callbacks, self._callbacks = self._callbacks, []
+            self.event.set()
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def add_done_callback(self, cb) -> None:
+        """Run `cb(self)` when the future completes (immediately if it
+        already has). Callbacks run on whichever thread completes the
+        future — keep them short and non-blocking on that connection."""
+        with self._cb_lock:
+            if not self.event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+    def remove_done_callback(self, cb) -> None:
+        """Deregister a pending callback (no-op if already fired) —
+        polling wait() loops must not accumulate one closure per call
+        on a long-pending future."""
+        with self._cb_lock:
+            try:
+                self._callbacks.remove(cb)
+            except ValueError:
+                pass
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        return self.event.wait(timeout)
+
+
+class _Lease:
+    __slots__ = (
+        "lease_id", "worker_id", "address", "client", "in_flight",
+        "last_used", "dead",
+    )
+
+    def __init__(self, lease_id, worker_id, address):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.address = address
+        self.client: Optional[RpcClient] = None
+        self.in_flight = 0
+        self.last_used = time.monotonic()
+        self.dead = False
+
+
+class _KeyState:
+    """Per-scheduling-key backlog + lease pool (lock: ks.lock)."""
+
+    __slots__ = ("queue", "lock", "leases", "requests_in_flight", "closed")
+
+    def __init__(self):
+        self.queue: List[dict] = []
+        self.lock = threading.Lock()
+        self.leases: Dict[str, _Lease] = {}
+        self.requests_in_flight = 0
+        self.closed = False
+
+
+def scheduling_key(spec: dict) -> tuple:
+    res = spec.get("resources") or {}
+    return (tuple(sorted(res.items())), res.get("TPU", 0) > 0)
+
+
+class DirectTaskManager:
+    """Driver-side direct submitter for normal tasks."""
+
+    def __init__(self, core):
+        self._core = core  # CoreWorker (driver role)
+        # RLock: forget()'s dict pop can drop the last reference to a
+        # future whose hold_refs chain ObjectRef.__del__ ->
+        # remove_local_ref -> forget() on the SAME thread (cyclic GC
+        # fires during the pop). A plain Lock self-deadlocks there.
+        self._lock = threading.RLock()
+        self._futures: Dict[bytes, Tuple[ResultFuture, int]] = {}
+        #: direct results already published to the daemon object table
+        #: (large/shm results are implicitly published by the worker).
+        self._published: set = set()
+        self._keys: Dict[tuple, _KeyState] = {}
+        self._shutdown = False
+        cfg = core.config
+        self._idle_timeout = cfg.worker_lease_idle_timeout_s
+        # The real concurrency gate is the daemon scheduler's resource
+        # admission (lease grants reserve the task's resources); this
+        # is only an anti-runaway cap. It must NOT be lower than the
+        # concurrency the declared resources admit — gang-rendezvous
+        # tasks (util.collective) deadlock if fewer workers can run
+        # than the resource model promises.
+        self._max_leases = max(1, cfg.direct_call_max_leases)
+        # One persistent requester/maintenance thread: lease-pool
+        # growth, idle lease release, starvation sweep. Never on the
+        # submit/reply hot path.
+        self._req_cond = threading.Condition()
+        self._req_jobs: List = []
+        self._req_thread: Optional[threading.Thread] = None
+
+    # -- eligibility ---------------------------------------------------
+    def eligible(self, spec: dict) -> bool:
+        if self._shutdown:
+            return False
+        if spec["kind"] != "normal":
+            return False
+        if spec.get("scheduling_strategy") or spec.get("pg_context"):
+            return False
+        if spec.get("runtime_env"):
+            return False
+        # TPU tasks ride the daemon path: gang resources and visibility
+        # env handling live there.
+        if (spec.get("resources") or {}).get("TPU", 0) > 0:
+            return False
+        return True
+
+    # -- submission ----------------------------------------------------
+    def register(self, spec: dict) -> ResultFuture:
+        """Create the shared future covering all of a spec's returns."""
+        fut = ResultFuture()
+        with self._lock:
+            for i, ret in enumerate(spec["returns"]):
+                self._futures[ret] = (fut, i)
+        return fut
+
+    def submit(self, spec: dict) -> None:
+        spec["_retries_left"] = spec.get("max_retries", 0)
+        key = scheduling_key(spec)
+        ks = self._key_state(key)
+        lease = None
+        want_more = False
+        with ks.lock:
+            lease = self._pick_lease(ks)
+            if lease is not None:
+                lease.in_flight += 1
+                lease.last_used = time.monotonic()
+            else:
+                ks.queue.append(spec)
+                # Grow the pool ONE request at a time: each grant
+                # chains the next while backlog remains (_on_lease_
+                # reply), so growth proceeds at grant latency (~1ms)
+                # but never floods the daemon's queue with requests it
+                # cannot admit — a 64-deep request backlog keeps
+                # churning grants/releases for seconds after the burst
+                # ends (reference: normal_task_submitter.cc pipelines
+                # exactly one lease request per scheduling key).
+                want_more = (
+                    ks.requests_in_flight == 0
+                    and len(ks.leases) < self._max_leases
+                )
+                if want_more:
+                    ks.requests_in_flight += 1
+        if lease is not None:
+            self._send(key, ks, lease, spec)
+        elif want_more:
+            self._enqueue_lease_request(key, ks)
+
+    @staticmethod
+    def _pick_lease(ks: _KeyState) -> Optional[_Lease]:
+        """Least-loaded live lease with pipeline room (caller holds
+        ks.lock)."""
+        best = None
+        for lease in ks.leases.values():
+            if lease.dead or lease.in_flight >= _PIPELINE_CAP:
+                continue
+            if best is None or lease.in_flight < best.in_flight:
+                best = lease
+        return best
+
+    def _key_state(self, key) -> _KeyState:
+        with self._lock:
+            ks = self._keys.get(key)
+            if ks is None:
+                ks = self._keys[key] = _KeyState()
+            return ks
+
+    # -- hot path ------------------------------------------------------
+    def _send(self, key, ks: _KeyState, lease: _Lease, spec: dict) -> None:
+        lease.client.call_async(
+            "execute_task",
+            lambda reply: self._on_reply(key, ks, lease, spec, reply),
+            spec=spec,
+        )
+
+    def _on_reply(self, key, ks, lease, spec, reply: dict) -> None:
+        """Runs on the lease connection's reader thread."""
+        if reply.get("_error") is not None:
+            self._on_lease_failure(key, ks, lease, spec, reply["_error"])
+            return
+        # Lease accounting BEFORE fulfilling: the fulfilled waiter may
+        # submit its next task immediately, and must see this lease as
+        # free or it queues the spec and grows the pool for nothing.
+        next_spec = None
+        with ks.lock:
+            if ks.queue and not ks.closed and not lease.dead:
+                next_spec = ks.queue.pop(0)
+                lease.last_used = time.monotonic()
+            else:
+                lease.in_flight -= 1
+                lease.last_used = time.monotonic()
+        if next_spec is not None:
+            self._send(key, ks, lease, next_spec)
+        self._fulfill(spec, reply)
+
+    # -- lease lifecycle -----------------------------------------------
+    def _enqueue_lease_request(self, key, ks: _KeyState) -> None:
+        self._enqueue_job(lambda: self._request_lease(key, ks))
+
+    def _enqueue_job(self, job) -> None:
+        with self._req_cond:
+            self._req_jobs.append(job)
+            if self._req_thread is None:
+                self._req_thread = threading.Thread(
+                    target=self._requester_loop, daemon=True,
+                    name="rt-lease-requester",
+                )
+                self._req_thread.start()
+            self._req_cond.notify()
+
+    def _requester_loop(self) -> None:
+        """Lease-pool maintenance off the hot path: run queued jobs
+        (lease grants/denials), release idle leases, rescue starved
+        queues (work queued, no request outstanding — e.g. every lease
+        busy with a long task)."""
+        while not self._shutdown:
+            with self._req_cond:
+                if not self._req_jobs:
+                    self._req_cond.wait(0.1)
+                batch, self._req_jobs = self._req_jobs, []
+            for job in batch:
+                try:
+                    job()
+                except Exception:
+                    pass
+            if self._shutdown:
+                return
+            with self._lock:
+                keys = list(self._keys.items())
+            now = time.monotonic()
+            for key, ks in keys:
+                to_release = []
+                starved = False
+                with ks.lock:
+                    for lid, lease in list(ks.leases.items()):
+                        if (
+                            lease.in_flight == 0
+                            and now - lease.last_used > self._idle_timeout
+                        ):
+                            del ks.leases[lid]
+                            to_release.append(lease)
+                    starved = (
+                        bool(ks.queue)
+                        and ks.requests_in_flight == 0
+                        and self._pick_lease(ks) is None
+                        and len(ks.leases) < self._max_leases
+                    )
+                    if starved:
+                        ks.requests_in_flight += 1
+                for lease in to_release:
+                    self._drop_lease(lease, release=True)
+                if starved:
+                    self._request_lease(key, ks)
+
+    def _request_lease(self, key, ks: _KeyState) -> None:
+        """Fire the lease request without blocking: the daemon defers
+        its reply until a worker is free (no client timeout — a timed
+        out request whose grant arrives later would leak the worker),
+        and the reply is handled as a requester-thread job."""
+        self._core._client.call_async(
+            "request_lease",
+            lambda reply: self._enqueue_job(
+                lambda: self._on_lease_reply(key, ks, reply)
+            ),
+            resources=dict(key[0]),
+            needs_tpu=key[1],
+        )
+
+    def _on_lease_reply(self, key, ks: _KeyState, reply: dict) -> None:
+        granted = None
+        if reply.get("address"):
+            granted = _Lease(
+                reply["lease_id"], reply["worker_id"], reply["address"]
+            )
+            try:
+                granted.client = RpcClient(granted.address)
+            except ConnectionLost:
+                self._core.notify(
+                    "release_lease", lease_id=granted.lease_id
+                )
+                granted = None
+        if granted is None:
+            with ks.lock:
+                ks.requests_in_flight -= 1
+                # Could not lease (daemon lost/infeasible): if nothing
+                # is serving this key, push queued work back to the
+                # daemon path so nothing strands.
+                if not ks.leases and not ks.requests_in_flight:
+                    stranded, ks.queue = ks.queue, []
+                else:
+                    stranded = []
+            for spec in stranded:
+                self._fallback_to_daemon(spec)
+            return
+        sends = []
+        chain = False
+        with ks.lock:
+            ks.requests_in_flight -= 1
+            if self._shutdown or ks.closed:
+                leave = True
+            else:
+                leave = False
+                ks.leases[granted.lease_id] = granted
+                while ks.queue and granted.in_flight < _PIPELINE_CAP:
+                    sends.append(ks.queue.pop(0))
+                    granted.in_flight += 1
+                granted.last_used = time.monotonic()
+                # Backlog remains: chain the next growth request.
+                if (
+                    ks.queue
+                    and ks.requests_in_flight == 0
+                    and len(ks.leases) < self._max_leases
+                ):
+                    ks.requests_in_flight += 1
+                    chain = True
+        if leave:
+            self._drop_lease(granted, release=True)
+            return
+        for spec in sends:
+            self._send(key, ks, granted, spec)
+        if chain:
+            self._request_lease(key, ks)
+
+    def _drop_lease(self, lease: _Lease, release: bool) -> None:
+        lease.dead = True
+        if lease.client is not None:
+            try:
+                lease.client.close()
+            except Exception:
+                pass
+        if release and not self._shutdown:
+            try:
+                self._core.notify("release_lease", lease_id=lease.lease_id)
+            except Exception:
+                pass
+
+    def _on_lease_failure(self, key, ks, lease, spec, err) -> None:
+        """Leased worker died (or the connection broke) with `spec` in
+        flight. System failure: retry on another lease if the task has
+        retries left (the task may have executed — at-least-once, the
+        reference's semantics for worker-crash retries), else fail."""
+        with ks.lock:
+            ks.leases.pop(lease.lease_id, None)
+        self._drop_lease(lease, release=False)  # daemon saw the death
+        if spec.get("_retries_left", 0) > 0:
+            spec["_retries_left"] -= 1
+            requeued = False
+            with ks.lock:
+                if not ks.closed:
+                    ks.queue.insert(0, spec)
+                    if ks.requests_in_flight == 0:
+                        ks.requests_in_flight += 1
+                        requeued = True
+            if requeued:
+                self._enqueue_lease_request(key, ks)
+        else:
+            payload = make_error_payload(
+                "WorkerCrashedError",
+                f"leased worker died while running task ({err})",
+            )
+            self._fulfill(spec, {"error": payload})
+
+    def _fallback_to_daemon(self, spec: dict) -> None:
+        """Strip direct bookkeeping and hand the spec to the daemon
+        path; mark its futures so get()/wait() consult the daemon."""
+        spec.pop("_retries_left", None)
+        with self._lock:
+            futures = {
+                self._futures.pop(ret, (None, 0))[0]
+                for ret in spec["returns"]
+            }
+        for fut in futures:
+            if fut is not None:
+                fut.to_daemon()
+        try:
+            self._core.call("submit_task", spec=spec)
+        except RpcError as e:
+            payload = make_error_payload(
+                "TaskError", f"daemon fallback submission failed: {e}"
+            )
+            for ret in spec["returns"]:
+                try:
+                    self._core.call("seal_error", oid=ret, error=payload)
+                except RpcError:
+                    pass
+        finally:
+            # The daemon has pinned the args (or sealed errors) now.
+            for fut in futures:
+                if fut is not None:
+                    fut.hold_refs = None
+
+    # -- results -------------------------------------------------------
+    def _fulfill(self, spec: dict, reply: dict) -> None:
+        fut = None
+        with self._lock:
+            # Any surviving return's entry holds the shared future
+            # (individual returns are forgotten as their refs are GC'd).
+            for ret in spec["returns"]:
+                entry = self._futures.get(ret)
+                if entry is not None:
+                    fut = entry[0]
+                    break
+        if fut is None:
+            # Every handle to the result was dropped before completion;
+            # nothing to record (the object was never globally visible).
+            return
+        fut.fulfill(reply.get("results"), reply.get("error"))
+
+    def lookup(self, oid: ObjectID):
+        with self._lock:
+            return self._futures.get(oid.binary())
+
+    def forget(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._futures.pop(oid.binary(), None)
+            self._published.discard(oid.binary())
+
+    def publish_when_done(self, oid: ObjectID) -> None:
+        """Arrange for a (possibly still pending) direct result to be
+        published to the daemon's object table once it completes —
+        used when a dependent spec carries the ref so the executing
+        worker's fetch can resolve daemon-side. Never blocks."""
+        entry = self.lookup(oid)
+        if entry is None:
+            return
+        fut, _ = entry
+
+        def _publish(_fut):
+            try:
+                self.ensure_published(oid)
+            except Exception:
+                pass
+
+        fut.add_done_callback(_publish)
+
+    def ensure_published(self, oid: ObjectID) -> bool:
+        """Make a direct inline result globally visible (daemon object
+        table) before its ref escapes this process — nested in another
+        value, or borrowed cross-process. Blocks until the producing
+        task finishes. Returns False if `oid` is not a direct result."""
+        entry = self.lookup(oid)
+        if entry is None:
+            return False
+        fut, index = entry
+        fut.wait(None)
+        if fut.daemon_fallback:
+            return True  # daemon already owns it
+        key = oid.binary()
+        with self._lock:
+            if key in self._published:
+                return True
+        if fut.error is not None:
+            self._core.call("seal_error", oid=key, error=fut.error)
+        else:
+            kind, payload = fut.results[index]
+            if kind == "inline":
+                self._core.call("put_inline", oid=key, data=payload)
+            # kind == "shm": the worker already sealed + reported it.
+        with self._lock:
+            self._published.add(key)
+        return True
+
+    # -- shutdown ------------------------------------------------------
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._req_cond:
+            self._req_cond.notify_all()
+        with self._lock:
+            keys = list(self._keys.values())
+        for ks in keys:
+            with ks.lock:
+                ks.closed = True
+                leases = list(ks.leases.values())
+                ks.leases.clear()
+            for lease in leases:
+                self._drop_lease(lease, release=False)
+
+
+class ActorDirectRouter:
+    """Per-actor direct call router.
+
+    A single thread per actor handle preserves submission order across
+    transport decisions: it resolves the actor's direct address
+    (blocking until the actor is ALIVE), then drains the call queue
+    over a dedicated connection. Remote-node actors and unrecoverable
+    connection failures fall back to the daemon path — sticky, so
+    ordering never interleaves between transports."""
+
+    def __init__(self, core, actor_id):
+        self._core = core
+        self._actor_id = actor_id
+        self._queue: List[tuple] = []
+        self._cond = threading.Condition()
+        self._mode = "resolving"  # resolving | direct | daemon | dead
+        self._client: Optional[RpcClient] = None
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"rt-actor-router-{actor_id.hex()[:8]}",
+        )
+        self._thread.start()
+
+    def submit(self, spec: dict, fut: ResultFuture) -> None:
+        with self._cond:
+            self._queue.append((spec, fut))
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while not self._shutdown:
+            with self._cond:
+                while not self._queue and not self._shutdown:
+                    self._cond.wait()
+                if self._shutdown:
+                    return
+                spec, fut = self._queue.pop(0)
+            self._dispatch(spec, fut)
+
+    def _dispatch(self, spec: dict, fut: ResultFuture) -> None:
+        if self._mode == "daemon":
+            self._send_daemon(spec, fut)
+            return
+        client = self._resolve()
+        if client is None:
+            self._send_daemon(spec, fut)
+            return
+        try:
+            reply = client.call("execute_task", spec=spec, timeout=None)
+        except (RpcError, ConnectionLost):
+            # Actor worker died (or connection broke) mid-call. Future
+            # calls re-route through the daemon (it fails or queues
+            # them per the actor's max_restarts state). The in-flight
+            # call may already have executed — re-submitting would
+            # break at-most-once actor semantics, so without retries it
+            # fails like the daemon path fails in-flight tasks on
+            # actor death (reference: actor_task_submitter
+            # DisconnectRpcClient wil_retry=false path).
+            self._teardown_client()
+            # Back to resolving: the daemon's actor_address defers
+            # while the actor restarts and answers with the NEW
+            # worker once ALIVE (or empty if it stays dead) — going
+            # daemon-sticky here would race the daemon's own death
+            # detection and strand calls on the dead host's queue.
+            self._mode = "resolving"
+            if spec.get("max_retries", 0) > 0:
+                spec["max_retries"] -= 1
+                with self._cond:
+                    self._queue.insert(0, (spec, fut))
+            else:
+                fut.fulfill(None, make_error_payload(
+                    "ActorDiedError",
+                    "actor worker died while executing direct call",
+                ))
+            return
+        fut.fulfill(reply.get("results"), reply.get("error"))
+
+    def _resolve(self) -> Optional[RpcClient]:
+        if self._client is not None:
+            return self._client
+        # Retry around the window where the actor's worker died but the
+        # daemon hasn't processed the death yet: actor_address still
+        # answers the OLD address (connect fails) until the daemon sees
+        # the disconnect, after which it defers until restart completes.
+        for attempt in range(50):
+            try:
+                reply = self._core.call(
+                    "actor_address",
+                    actor_id=self._actor_id.binary(),
+                    timeout=None,
+                )
+            except RpcError:
+                break
+            address = reply.get("address")
+            if not address:
+                break  # remote node / dead — daemon path owns it
+            try:
+                self._client = RpcClient(address, connect_timeout=0.5)
+            except ConnectionLost:
+                time.sleep(min(0.02 * (attempt + 1), 0.2))
+                continue
+            self._mode = "direct"
+            return self._client
+        self._mode = "daemon"
+        return None
+
+    def _send_daemon(self, spec: dict, fut: ResultFuture) -> None:
+        fut.to_daemon()
+        try:
+            self._core.call("submit_actor_task", spec=spec)
+        except RpcError as e:
+            payload = make_error_payload(
+                "ActorDiedError", f"actor submission failed: {e}"
+            )
+            for ret in spec["returns"]:
+                try:
+                    self._core.call("seal_error", oid=ret, error=payload)
+                except RpcError:
+                    pass
+        finally:
+            fut.hold_refs = None  # daemon owns arg pinning now
+
+    def _teardown_client(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._cond:
+            self._cond.notify_all()
+        self._teardown_client()

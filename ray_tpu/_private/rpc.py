@@ -441,6 +441,10 @@ class RpcClient:
         self._send_lock = threading.Lock()
         self._reconnect_lock = threading.Lock()
         self._pending: Dict[int, threading.Event] = {}
+        #: mid -> callback for async calls (call_async); invoked on the
+        #: reader thread with the reply dict, or with
+        #: {"_error": "__connection_lost__"} if the connection dies.
+        self._pending_cb: Dict[int, Callable[[dict], None]] = {}
         #: mid -> connection generation the request was SENT on (absent
         #: until the send completes). Reconnect only fails mids sent on
         #: an older generation; a request that slipped onto the new
@@ -530,8 +534,14 @@ class RpcClient:
                 event = self._pending.pop(mid, None)
                 if event is not None:
                     self._replies[mid] = msg
+                callback = self._pending_cb.pop(mid, None)
             if event is not None:
                 event.set()
+            if callback is not None:
+                try:
+                    callback(msg)
+                except Exception:
+                    pass
         # Connection lost: wake all waiters with an error — but only if
         # this reader still owns the live connection; a stale reader
         # must not fail calls issued on its replacement.
@@ -543,6 +553,13 @@ class RpcClient:
                 event.set()
             self._pending.clear()
             self._pending_gen.clear()
+            callbacks = list(self._pending_cb.values())
+            self._pending_cb.clear()
+        for callback in callbacks:
+            try:
+                callback({"_error": "__connection_lost__"})
+            except Exception:
+                pass
 
     def call(
         self,
@@ -611,6 +628,37 @@ class RpcClient:
             self._pending_gen.pop(mid, None)
             return self._replies.pop(mid)
 
+    def call_async(
+        self, method: str, callback: Callable[[dict], None], **kwargs
+    ) -> None:
+        """Fire a request and invoke `callback(reply)` on the reader
+        thread when the response arrives (or with
+        ``{"_error": "__connection_lost__"}`` on connection loss). The
+        hot path of the direct task transport: no per-call thread
+        handoff on the send side."""
+        with self._lock:
+            if self._closed:
+                callback({"_error": "__connection_lost__"})
+                return
+            self._mid += 1
+            mid = self._mid
+            self._pending_cb[mid] = callback
+        msg = dict(kwargs)
+        msg["_method"] = method
+        msg["_mid"] = mid
+        try:
+            with self._send_lock:
+                send_msg(self._sock, msg, self._conn_key)
+                with self._lock:
+                    if mid in self._pending_cb:
+                        self._pending_gen[mid] = self._conn_gen
+        except ConnectionLost:
+            with self._lock:
+                dead = self._pending_cb.pop(mid, None)
+                self._pending_gen.pop(mid, None)
+            if dead is not None:
+                dead({"_error": "__connection_lost__"})
+
     def notify(self, method: str, **kwargs) -> None:
         """Fire-and-forget message (no reply expected)."""
         msg = dict(kwargs)
@@ -666,6 +714,19 @@ class RpcClient:
                             "_error": "__connection_lost__"
                         }
                         event.set()
+                    # Same for async-callback requests: their promised
+                    # connection_lost error must fire or the caller's
+                    # in-flight accounting wedges.
+                    stale_cbs = []
+                    for mid, g in list(self._pending_gen.items()):
+                        if g < gen and mid in self._pending_cb:
+                            stale_cbs.append(self._pending_cb.pop(mid))
+                            self._pending_gen.pop(mid, None)
+            for cb in stale_cbs:
+                try:
+                    cb({"_error": "__connection_lost__"})
+                except Exception:
+                    pass
             self._start_reader(sock, key, gen)
 
     def close(self) -> None:

@@ -94,17 +94,40 @@ class CoreWorker:
         # Execution state must exist before the RPC client starts its
         # reader thread: the daemon may push execute_task immediately
         # after (even before) the register reply.
-        self._task_queue: "queue.Queue[dict]" = queue.Queue()
+        self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
         self._actor_pg_context: Optional[dict] = None
         self._running = True
+        # Direct task transport (reference: normal_task_submitter.cc
+        # worker-to-worker task push). Workers serve a tiny RPC
+        # endpoint; drivers lease workers and push specs straight to
+        # it, with results inline in the reply (_private/direct.py).
+        self._direct_server = None
+        direct_address = None
+        if role == "worker":
+            from .rpc import DEFERRED, RpcServer
+
+            session_dir = os.path.dirname(os.path.abspath(socket_path))
+            direct_address = os.path.join(
+                session_dir, f"dworker-{os.getpid()}.sock"
+            )
+            self._direct_server = RpcServer(direct_address)
+
+            def _h_direct_execute(conn, msg):
+                self._task_queue.put((msg["spec"], (conn, msg["_mid"])))
+                return DEFERRED
+
+            self._direct_server.register("execute_task", _h_direct_execute)
+            self._direct_server.register("ping", lambda conn, msg: {})
+            self._direct_server.start()
         self._client = RpcClient(socket_path, push_handler=self._on_push)
         reply = self._client.call(
             "register_client",
             role=role,
             pid=os.getpid(),
             is_tpu=os.environ.get("RT_WORKER_TPU") == "1",
+            direct_address=direct_address,
         )
         self.node_id = NodeID(reply["node_id"])
         self.config = Config(**reply["config"])
@@ -124,7 +147,30 @@ class CoreWorker:
         self.functions = FunctionManager(self._client)
         self._ctx = _TaskContext()
         self._ref_counts: Dict[ObjectID, int] = {}
-        self._ref_lock = threading.Lock()
+        # RLock: remove_local_ref runs from ObjectRef.__del__, which
+        # the cyclic GC can fire during an allocation made while this
+        # lock is already held on the same thread.
+        self._ref_lock = threading.RLock()
+        #: Owner-side cache of small put() values (serialized): local
+        #: gets never leave the process; the daemon registration rides
+        #: an async notify (same-connection FIFO keeps any dependent
+        #: message ordered after it). Entries die with the local ref.
+        #: (reference: CoreWorkerMemoryStore for small owned objects.)
+        self._inline_cache: Dict[ObjectID, bytes] = {}
+        #: Batched ref-release notifications: one daemon wakeup per
+        #: batch instead of one per ObjectRef GC (the wakeup cost
+        #: dominates on small hosts). A parked flusher thread drains
+        #: the batch ~50ms after the first drop, so deletion stays
+        #: prompt without per-ref traffic.
+        self._pending_dels: List[bytes] = []
+        self._del_flush_evt = threading.Event()
+        self._del_flusher: Optional[threading.Thread] = None
+        self._direct = None
+        self._actor_routers: Dict[ActorID, Any] = {}
+        if role == "driver" and self.config.use_direct_calls:
+            from .direct import DirectTaskManager
+
+            self._direct = DirectTaskManager(self)
 
     def _notify_store_evict(self, oid: ObjectID) -> None:
         """Arena evictions can originate in any process; tell the node
@@ -148,15 +194,52 @@ class CoreWorker:
             count = self._ref_counts.get(oid, 0) - 1
             if count <= 0:
                 self._ref_counts.pop(oid, None)
+                self._inline_cache.pop(oid, None)
                 notify = True
             else:
                 self._ref_counts[oid] = count
                 notify = False
         if notify:
-            try:
-                self._client.notify("del_ref", oids=[oid.binary()])
-            except Exception:
-                pass
+            if self._direct is not None:
+                self._direct.forget(oid)
+            start_flusher = None
+            with self._ref_lock:
+                self._pending_dels.append(oid.binary())
+                flush = len(self._pending_dels) >= 64
+                if self._del_flusher is None:
+                    # Construct/start outside the lock: Thread() can
+                    # allocate enough to trigger GC -> __del__ ->
+                    # re-entry here.
+                    self._del_flusher = start_flusher = threading.Thread(
+                        target=self._del_flush_loop,
+                        name="rt-del-flusher",
+                        daemon=True,
+                    )
+            if start_flusher is not None:
+                start_flusher.start()
+            if flush:
+                self.flush_pending_dels()
+            else:
+                self._del_flush_evt.set()
+
+    def _del_flush_loop(self) -> None:
+        while self._running:
+            self._del_flush_evt.wait()  # parked while nothing pends
+            self._del_flush_evt.clear()
+            if not self._running:
+                return
+            time.sleep(0.05)  # debounce a GC burst into one notify
+            self.flush_pending_dels()
+
+    def flush_pending_dels(self) -> None:
+        with self._ref_lock:
+            if not self._pending_dels:
+                return
+            batch, self._pending_dels = self._pending_dels, []
+        try:
+            self._client.notify("del_ref", oids=batch)
+        except Exception:
+            pass
 
     def notify_borrowed_ref(self, oid: ObjectID) -> None:
         self._client.notify("add_ref", oids=[oid.binary()])
@@ -182,17 +265,32 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
         oid = self._next_put_id()
-        self.put_object(oid, value)
+        self.put_object(oid, value, cache=True)
         return ObjectRef(oid, owner=self)
 
-    def put_object(self, oid: ObjectID, value: Any) -> Tuple[str, Any]:
-        """Serialize and store; returns ("inline", bytes) or ("shm", size)."""
+    def put_object(
+        self, oid: ObjectID, value: Any, cache: bool = False
+    ) -> Tuple[str, Any]:
+        """Serialize and store; returns ("inline", bytes) or ("shm", size).
+
+        `cache=True` (explicit put(): an ObjectRef will hold a local
+        ref whose release evicts the entry) keeps small values in the
+        owner-side inline cache. Task-return storage passes False —
+        no local ref exists to bound the cache."""
         serialized = self.serialization.serialize(value)
         size = serialized.total_size()
         if size <= self.config.max_direct_call_object_size:
             data = serialized.to_bytes()
-            self._client.call("put_inline", oid=oid.binary(), data=data)
+            if cache:
+                with self._ref_lock:
+                    self._inline_cache[oid] = data
+            # Async registration: the daemon's deferred-waiter get path
+            # answers anyone who asks before the notify lands.
+            self._client.notify("put_inline", oid=oid.binary(), data=data)
             return ("inline", data)
+        # Large object: flush deferred ref-drops first so the daemon's
+        # eviction view is current when space is tight.
+        self.flush_pending_dels()
         buf = self.store.create(oid, size)
         used = serialized.write_to(buf)
         self.store.seal(oid)
@@ -215,6 +313,35 @@ class CoreWorker:
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
         deadline = None if timeout is None else time.time() + timeout
+        with self._ref_lock:
+            cached = self._inline_cache.get(oid)
+        if cached is not None:
+            return self.serialization.deserialize(cached)
+        if self._direct is not None:
+            entry = self._direct.lookup(oid)
+            if entry is not None:
+                fut, index = entry
+                if not fut.wait(timeout):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid}"
+                    )
+                # One deadline across future-wait and whatever follows
+                # (store read or daemon fallback) — not timeout twice.
+                timeout = (
+                    None if deadline is None else deadline - time.time()
+                )
+                if not fut.daemon_fallback:
+                    if fut.error is not None:
+                        raise_from_payload(fut.error)
+                    kind, payload = fut.results[index]
+                    if kind == "inline":
+                        return self.serialization.deserialize(payload)
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.time()
+                    )
+                    return self._read_local_store(oid, payload, remaining)
+                # fell back to the daemon path: ask it below
         try:
             reply = self._client.call(
                 "get_object", oid=oid.binary(), timeout=timeout
@@ -229,7 +356,15 @@ class CoreWorker:
             raise_from_payload(reply["error"])
         if reply.get("inline") is not None:
             return self.serialization.deserialize(reply["inline"])
-        size = reply["shm_size"]
+        remaining = None if deadline is None else deadline - time.time()
+        return self._read_local_store(oid, reply["shm_size"], remaining)
+
+    def _read_local_store(
+        self, oid: ObjectID, size: int, timeout: Optional[float]
+    ) -> Any:
+        """Zero-copy read of a sealed object from the node's shared
+        store (segment or native arena)."""
+        deadline = None if timeout is None else time.time() + timeout
         # Sealed objects are immutable (plasma semantics): readers get
         # read-only views, so zero-copy numpy arrays can't corrupt them.
         if not getattr(self.store, "needs_release", False):
@@ -318,6 +453,91 @@ class CoreWorker:
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         if not refs:
             return [], []
+        direct: Dict[ObjectRef, Any] = {}
+        if self._direct is not None:
+            for ref in refs:
+                entry = self._direct.lookup(ref.id())
+                if entry is not None:
+                    direct[ref] = entry[0]
+        if not direct:
+            return self._wait_daemon(refs, num_returns, timeout)
+        # Direct futures are owner-local; poll them alongside the
+        # daemon set in slices (mixed sets are rare — usually a wait()
+        # is all-direct, where the loop blocks on an any-completion
+        # event with no daemon traffic).
+        deadline = None if timeout is None else time.time() + timeout
+        daemon_refs = [r for r in refs if r not in direct]
+        any_done = threading.Event()
+
+        def _on_done(_fut):
+            any_done.set()
+
+        registered = set(direct.values())
+        for fut in registered:
+            fut.add_done_callback(_on_done)
+        try:
+            return self._wait_mixed(
+                refs, direct, daemon_refs, num_returns, deadline, any_done
+            )
+        finally:
+            for fut in registered:
+                fut.remove_done_callback(_on_done)
+
+    def _wait_mixed(
+        self, refs, direct, daemon_refs, num_returns, deadline, any_done
+    ):
+        while True:
+            ready, remaining = [], []
+            for ref in refs:
+                fut = direct.get(ref)
+                if fut is None:
+                    remaining.append(ref)  # resolved via daemon below
+                elif fut.daemon_fallback:
+                    daemon_refs.append(ref)
+                    del direct[ref]
+                    remaining.append(ref)
+                elif fut.event.is_set():
+                    ready.append(ref)
+                else:
+                    remaining.append(ref)
+            if daemon_refs and len(ready) < num_returns:
+                d_ready, _ = self._wait_daemon(
+                    daemon_refs, len(daemon_refs), 0.0
+                )
+                ready.extend(d_ready)
+                remaining = [r for r in remaining if r not in set(d_ready)]
+            if len(ready) >= num_returns:
+                return ready[:num_returns], [
+                    r for r in refs if r not in set(ready[:num_returns])
+                ]
+            now = time.time()
+            if deadline is not None and now >= deadline:
+                return ready, remaining
+            slice_t = 0.05 if daemon_refs else (
+                None if deadline is None else deadline - now
+            )
+            if deadline is not None and slice_t is not None:
+                slice_t = min(slice_t, max(deadline - now, 0.0))
+            pending = [f for f in direct.values() if not f.event.is_set()]
+            if pending:
+                # Any single completion wakes the wait (each future
+                # sets any_done via its done-callback).
+                any_done.clear()
+                if any(f.event.is_set() for f in pending):
+                    continue  # completed between scan and clear
+                any_done.wait(slice_t)
+            elif daemon_refs:
+                time.sleep(min(slice_t or 0.05, 0.05))
+            else:
+                # everything direct is done but num_returns unreachable
+                return ready, remaining
+
+    def _wait_daemon(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         by_id = {r.binary(): r for r in refs}
         reply = self._client.call(
             "wait_objects",
@@ -337,7 +557,7 @@ class CoreWorker:
         out = []
         for arg in args:
             if isinstance(arg, ObjectRef):
-                out.append(("ref", arg.binary()))
+                out.append(self._serialize_ref_arg(arg))
                 continue
             serialized = self.serialization.serialize(arg)
             size = serialized.total_size()
@@ -355,6 +575,46 @@ class CoreWorker:
                 )
                 out.append(("ref", oid.binary()))
         return out
+
+    def _serialize_ref_arg(self, arg: ObjectRef) -> tuple:
+        """Owner-side dependency resolution for direct-call results
+        (reference: normal_task_submitter.cc DependencyResolver —
+        the owner waits for locally-owned results and inlines small
+        ones into the dependent spec). Non-direct refs pass through."""
+        if self._direct is None:
+            return ("ref", arg.binary())
+        entry = self._direct.lookup(arg.id())
+        if entry is None:
+            return ("ref", arg.binary())
+        fut, index = entry
+        if fut.event.is_set() and not fut.daemon_fallback:
+            if fut.error is not None:
+                # Publish the error to the daemon table so the
+                # dependent task fails with the underlying cause.
+                self._direct.ensure_published(arg.id())
+                return ("ref", arg.binary())
+            kind, payload = fut.results[index]
+            if kind == "inline":
+                return ("inline", payload)
+            return ("ref", arg.binary())  # shm: worker registered it
+        # Still pending (or daemon-owned): never block submission —
+        # pass the ref through and publish the result to the daemon's
+        # object table when it lands, so the executing worker's fetch
+        # resolves (chains stay pipelined; reference: the owner
+        # resolves dependencies asynchronously, dependency_resolver.cc).
+        self._direct.publish_when_done(arg.id())
+        return ("ref", arg.binary())
+
+    def ensure_globally_visible(self, oid: ObjectID) -> None:
+        """Called when a ref escapes this process (pickled into a
+        value or borrowed): direct inline results must reach the
+        daemon's object table first or the borrower can never resolve
+        them."""
+        if self._direct is not None:
+            try:
+                self._direct.ensure_published(oid)
+            except Exception:
+                pass
 
     def submit_task(
         self,
@@ -386,7 +646,12 @@ class CoreWorker:
             "pg_context": pg_context,
             "runtime_env": runtime_env,
         }
-        self._client.call("submit_task", spec=spec)
+        if self._direct is not None and self._direct.eligible(spec):
+            fut = self._direct.register(spec)
+            fut.hold_refs = [a for a in args if isinstance(a, ObjectRef)]
+            self._direct.submit(spec)
+        else:
+            self._client.call("submit_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
 
     def create_actor(
@@ -451,8 +716,23 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "max_retries": max_retries,
         }
-        self._client.call("submit_actor_task", spec=spec)
+        if self._direct is not None:
+            fut = self._direct.register(spec)
+            fut.hold_refs = [a for a in args if isinstance(a, ObjectRef)]
+            self._actor_router(actor_id).submit(spec, fut)
+        else:
+            self._client.call("submit_actor_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
+
+    def _actor_router(self, actor_id: ActorID):
+        router = self._actor_routers.get(actor_id)
+        if router is None:
+            from .direct import ActorDirectRouter
+
+            router = self._actor_routers.setdefault(
+                actor_id, ActorDirectRouter(self, actor_id)
+            )
+        return router
 
     # ------------------------------------------------------------------
     # misc API
@@ -468,7 +748,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def _on_push(self, channel: str, msg: dict) -> None:
         if channel == "execute_task":
-            self._task_queue.put(msg["spec"])
+            self._task_queue.put((msg["spec"], None))
         elif channel == "exit":
             self._running = False
             self._task_queue.put(None)
@@ -480,14 +760,53 @@ class CoreWorker:
 
     def run_task_loop(self) -> None:
         """Blocking execution loop (reference:
-        CoreWorkerProcess::RunTaskExecutionLoop)."""
+        CoreWorkerProcess::RunTaskExecutionLoop). Consumes both
+        daemon-pushed specs (reply_to None) and direct-transport specs
+        (reply_to carries the deferred RPC reply handle) from one
+        queue, preserving single-threaded execution and per-connection
+        arrival order."""
         while self._running:
-            spec = self._task_queue.get()
-            if spec is None:
+            item = self._task_queue.get()
+            if item is None:
                 return
-            self._execute(spec)
+            spec, reply_to = item
+            self._execute(spec, reply_to)
 
-    def _execute(self, spec: dict) -> None:
+    def _direct_reply(self, reply_to, payload: dict) -> None:
+        conn, mid = reply_to
+        conn.reply(mid, payload)
+
+    def _report_direct_task_events(
+        self, spec: dict, start: float, failed: bool
+    ) -> None:
+        """Direct-transport tasks never transit the daemon, so the
+        executing worker reports their state events (reference:
+        task_event_buffer.h — workers batch events to the GCS)."""
+        if not self.config.task_events_enabled:
+            return
+        tid = spec["task_id"]
+        base = {
+            "task_id": tid.hex() if isinstance(tid, bytes) else str(tid),
+            "name": spec.get("name", ""),
+            "kind": spec.get("kind", "normal"),
+        }
+        try:
+            self._client.notify(
+                "task_event",
+                events=[
+                    dict(base, state="RUNNING", time=start),
+                    dict(
+                        base,
+                        state="FAILED" if failed else "FINISHED",
+                        time=time.time(),
+                    ),
+                ],
+            )
+        except Exception:
+            pass
+
+    def _execute(self, spec: dict, reply_to=None) -> None:
+        start_time = time.time()
         task_id = TaskID(spec["task_id"])
         self._ctx.task_id = task_id
         self._ctx.put_index = 0
@@ -544,16 +863,50 @@ class CoreWorker:
                     )
         except BaseException as e:  # noqa: BLE001 — any task failure
             payload = make_exception_payload(e)
-            self._client.notify(
-                "task_done",
-                task_id=spec["task_id"],
-                error=payload,
-                system_error=False,
-            )
+            if reply_to is not None:
+                self._direct_reply(reply_to, {"error": payload})
+                self._report_direct_task_events(spec, start_time, True)
+            else:
+                self._client.notify(
+                    "task_done",
+                    task_id=spec["task_id"],
+                    error=payload,
+                    system_error=False,
+                )
             return
         finally:
             self._ctx.task_id = None
             self._ctx.pg_context = None
+        if reply_to is not None:
+            # Direct transport: results ride the reply — small ones
+            # inline (never touching the daemon), large ones sealed
+            # into the shared store and reported so any process can
+            # map them zero-copy.
+            try:
+                wire = []
+                for oid_bytes, value in zip(spec["returns"], results):
+                    serialized = self.serialization.serialize(value)
+                    size = serialized.total_size()
+                    if size <= self.config.max_direct_call_object_size:
+                        wire.append(("inline", serialized.to_bytes()))
+                    else:
+                        oid = ObjectID(oid_bytes)
+                        buf = self.store.create(oid, size)
+                        used = serialized.write_to(buf)
+                        self.store.seal(oid)
+                        self._client.call(
+                            "object_sealed", oid=oid_bytes, size=used
+                        )
+                        wire.append(("shm", used))
+            except BaseException as e:  # noqa: BLE001
+                self._direct_reply(reply_to, {"error": make_error_payload(
+                    "TaskError", f"failed to store results: {e!r}"
+                )})
+                self._report_direct_task_events(spec, start_time, True)
+                return
+            self._direct_reply(reply_to, {"results": wire})
+            self._report_direct_task_events(spec, start_time, False)
+            return
         try:
             for oid_bytes, value in zip(spec["returns"], results):
                 self.put_object(ObjectID(oid_bytes), value)
@@ -591,7 +944,19 @@ class CoreWorker:
 
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
+        self.flush_pending_dels()
         self._running = False
+        self._del_flush_evt.set()  # unpark the flusher so it exits
+        if self._direct is not None:
+            self._direct.shutdown()
+        for router in list(self._actor_routers.values()):
+            router.shutdown()
+        self._actor_routers.clear()
+        if self._direct_server is not None:
+            try:
+                self._direct_server.close()
+            except Exception:
+                pass
         try:
             self._client.close()
         except Exception:

@@ -13,14 +13,35 @@ pipeline ride ICI/DCN collectives inside the jitted program
 
 from __future__ import annotations
 
+import ctypes
 import pickle
 import struct
+import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Optional
 
-_HEADER = 16  # two u64 counters: head (written), tail (read)
+_HEADER = 24  # three u64s: head (written), tail (read), closed flag
 _LEN = 8  # per-record length prefix
+
+
+def _atomics():
+    """(load_acquire, store_release) on u64 addresses, from the native
+    library — real fences, correct on any architecture. Falls back to
+    None (plain struct access, safe on x86-TSO where CPython's stores
+    aren't reordered) when the toolchain is unavailable."""
+    try:
+        from .._native import load_library
+
+        lib = load_library()
+        if lib is not None and hasattr(lib, "rts_load_acq_u64"):
+            return lib.rts_load_acq_u64, lib.rts_store_rel_u64
+    except Exception:
+        pass
+    return None
+
+
+_ATOMICS = _atomics()
 
 STOP = b"__RT_DAG_STOP__"
 
@@ -61,19 +82,52 @@ class ShmChannel:
                 pass
         self.name = self._shm.name
         self._closed = False
+        # Base address of the header for the native atomic accessors.
+        self._base_addr = ctypes.addressof(
+            ctypes.c_char.from_buffer(self._shm.buf)
+        )
+        # Guards counter access against close() unmapping the segment:
+        # a native atomic load on an unmapped address is a segfault,
+        # not an exception.
+        self._io_lock = threading.Lock()
 
     # -- counters ------------------------------------------------------
+    # head/tail publication follows the release/acquire pattern: the
+    # writer stores payload bytes, then store-releases head; the reader
+    # load-acquires head before reading the bytes (and symmetrically
+    # for tail). With the native library absent this degrades to plain
+    # accesses — safe on x86-TSO, where CPython emits no reordering.
+    def _load(self, offset: int) -> int:
+        with self._io_lock:
+            if self._closed:
+                raise ChannelClosedError(self.name)
+            if _ATOMICS is not None:
+                return int(_ATOMICS[0](self._base_addr + offset))
+            return struct.unpack_from("<Q", self._shm.buf, offset)[0]
+
+    def _store(self, offset: int, v: int) -> None:
+        with self._io_lock:
+            if self._closed:
+                raise ChannelClosedError(self.name)
+            if _ATOMICS is not None:
+                _ATOMICS[1](self._base_addr + offset, v)
+                return
+            struct.pack_into("<Q", self._shm.buf, offset, v)
+
     def _head(self) -> int:
-        return struct.unpack_from("<Q", self._shm.buf, 0)[0]
+        return self._load(0)
 
     def _tail(self) -> int:
-        return struct.unpack_from("<Q", self._shm.buf, 8)[0]
+        return self._load(8)
 
     def _set_head(self, v: int) -> None:
-        struct.pack_into("<Q", self._shm.buf, 0, v)
+        self._store(0, v)
 
     def _set_tail(self, v: int) -> None:
-        struct.pack_into("<Q", self._shm.buf, 8, v)
+        self._store(8, v)
+
+    def _shared_closed(self) -> bool:
+        return self._load(16) != 0
 
     # -- ring IO -------------------------------------------------------
     def _write_at(self, pos: int, payload: bytes) -> None:
@@ -105,7 +159,7 @@ class ShmChannel:
             )
         deadline = None if timeout is None else time.monotonic() + timeout
         while self.capacity - (self._head() - self._tail()) < record:
-            if self._closed:
+            if self._closed or self._shared_closed():
                 raise ChannelClosedError(self.name)
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(f"put on {self.name}")
@@ -118,7 +172,7 @@ class ShmChannel:
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout
         while self._head() - self._tail() < _LEN:
-            if self._closed:
+            if self._closed or self._shared_closed():
                 raise ChannelClosedError(self.name)
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(f"get on {self.name}")
@@ -136,11 +190,19 @@ class ShmChannel:
         return pickle.loads(self.get_bytes(timeout=timeout))
 
     def close(self) -> None:
-        self._closed = True
         try:
-            self._shm.close()
-        except BufferError:
+            # Shared flag first (while still mapped): a peer blocked in
+            # put/get on the other side of the ring sees it and raises
+            # instead of spinning forever (`_closed` is process-local).
+            self._store(16, 1)
+        except Exception:
             pass
+        with self._io_lock:
+            self._closed = True
+            try:
+                self._shm.close()
+            except BufferError:
+                pass
 
     def unlink(self) -> None:
         try:

@@ -49,7 +49,15 @@ class ObjectRef:
 
     def __reduce__(self):
         # Refs serialized into task args / object values re-attach to
-        # the receiving process's worker on deserialization.
+        # the receiving process's worker on deserialization. A ref
+        # escaping its owner must first be globally visible: direct
+        # transport results live only in the owner's futures until
+        # published to the daemon's object table.
+        owner = self._owner
+        if owner is not None:
+            visible = getattr(owner, "ensure_globally_visible", None)
+            if visible is not None:
+                visible(self._id)
         return (_deserialize_ref, (self._id.binary(),))
 
     # `await ref` support for async drivers.
