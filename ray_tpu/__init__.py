@@ -25,7 +25,7 @@ from .api import (
     wait,
 )
 from .actor import ActorClass, ActorHandle
-from .object_ref import ObjectRef
+from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
 
 __version__ = "0.1.0"
@@ -47,6 +47,7 @@ __all__ = [
     "timeline",
     "state_summary",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",

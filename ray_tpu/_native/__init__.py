@@ -214,11 +214,14 @@ class NativeArena:
                 ctypes.byref(size),
                 1 if sealed_only else 0,
             )
-        if offset < 0:
-            return None
-        return self._view(offset, max(int(size.value), 1))[
-            : int(size.value)
-        ]
+            if offset < 0:
+                return None
+            # View built inside the critical section: offset is only
+            # meaningful while nothing can close/delete in between
+            # (same atomic lookup+view shape as try_pin).
+            return self._view(offset, max(int(size.value), 1))[
+                : int(size.value)
+            ]
 
     def contains(self, oid: bytes) -> bool:
         return self.get(oid) is not None

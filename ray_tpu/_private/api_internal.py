@@ -106,6 +106,7 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
         resources, strategy, pg_context = _resolve_placement(
             opts, resources, worker
         )
+    _validate_num_returns(num_returns)
     refs = worker.submit_task(
         func_key,
         _flatten_args(args, kwargs),
@@ -117,6 +118,34 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
         pg_context=pg_context,
         runtime_env=prepare_runtime_env(opts.get("runtime_env"), worker),
     )
+    return _generator_or_refs(refs, num_returns, worker)
+
+
+def _validate_num_returns(num_returns) -> None:
+    if isinstance(num_returns, str):
+        if num_returns not in ("dynamic", "streaming"):
+            raise ValueError(
+                'num_returns must be an int, "dynamic", or "streaming"'
+            )
+    elif not isinstance(num_returns, int) or num_returns < 1:
+        raise ValueError(f"bad num_returns: {num_returns!r}")
+
+
+def _generator_or_refs(refs, num_returns, worker):
+    """Map declared returns to the user-facing handle (reference:
+    remote_function.py:385-391 — "streaming" hands back a generator
+    immediately; "dynamic" hands back one ref whose value resolves to
+    the generator once the task finishes)."""
+    if num_returns == "streaming":
+        from ..object_ref import ObjectRefGenerator
+
+        # The generator must keep the submit-returned primary ref
+        # alive: it holds the owner-side future __next__ waits on.
+        return ObjectRefGenerator(
+            refs[0].id().task_id(), owner=worker, primary_ref=refs[0]
+        )
+    if num_returns == "dynamic":
+        return refs[0]
     return refs[0] if num_returns == 1 else refs
 
 
@@ -140,6 +169,7 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         namespace=opts.get("namespace", "default"),
         resources=resources,
         max_restarts=opts.get("max_restarts", 0),
+        max_concurrency=int(opts.get("max_concurrency", 1)),
         handle_meta=meta,
         scheduling_strategy=strategy,
         pg_context=pg_context,
@@ -155,13 +185,14 @@ def submit_actor_method(
     method: str,
     args: tuple,
     kwargs: dict,
-    num_returns: int = 1,
+    num_returns=1,
 ):
     worker = _require_worker()
+    _validate_num_returns(num_returns)
     refs = worker.submit_actor_task(
         handle.actor_id,
         method,
         _flatten_args(args, kwargs),
         num_returns=num_returns,
     )
-    return refs[0] if num_returns == 1 else refs
+    return _generator_or_refs(refs, num_returns, worker)

@@ -319,7 +319,15 @@ class DirectTaskManager:
         while not self._shutdown:
             with self._req_cond:
                 if not self._req_jobs:
-                    self._req_cond.wait(0.1)
+                    # Timed wait only while there is lease state to
+                    # sweep; otherwise park until the next job arrives
+                    # (no 10 Hz idle wakeups for the driver's life).
+                    with self._lock:
+                        has_state = any(
+                            ks.leases or ks.queue
+                            for ks in self._keys.values()
+                        )
+                    self._req_cond.wait(0.1 if has_state else None)
                 batch, self._req_jobs = self._req_jobs, []
             for job in batch:
                 try:
@@ -539,13 +547,18 @@ class DirectTaskManager:
     def ensure_published(self, oid: ObjectID) -> bool:
         """Make a direct inline result globally visible (daemon object
         table) before its ref escapes this process — nested in another
-        value, or borrowed cross-process. Blocks until the producing
-        task finishes. Returns False if `oid` is not a direct result."""
+        value, or borrowed cross-process. A still-pending result is
+        published on completion (never blocks the caller: consumers
+        block daemon-side until the publish lands, so pickling a
+        pending ref keeps pipelining). Returns False if `oid` is not a
+        direct result."""
         entry = self.lookup(oid)
         if entry is None:
             return False
         fut, index = entry
-        fut.wait(None)
+        if not fut.event.is_set():
+            self.publish_when_done(oid)
+            return True
         if fut.daemon_fallback:
             return True  # daemon already owns it
         key = oid.binary()
@@ -626,28 +639,35 @@ class ActorDirectRouter:
         if client is None:
             self._send_daemon(spec, fut)
             return
-        try:
-            reply = client.call("execute_task", spec=spec, timeout=None)
-        except (RpcError, ConnectionLost):
-            # Actor worker died (or connection broke) mid-call. Future
-            # calls re-route through the daemon (it fails or queues
-            # them per the actor's max_restarts state). The in-flight
-            # call may already have executed — re-submitting would
-            # break at-most-once actor semantics, so without retries it
-            # fails like the daemon path fails in-flight tasks on
-            # actor death (reference: actor_task_submitter
-            # DisconnectRpcClient wil_retry=false path).
+        # Pipelined send: the reply is handled on the connection's
+        # reader thread, so N calls can be in flight at once — the
+        # worker's task queue (and its max_concurrency pool) provides
+        # the actual concurrency. Send order on one socket preserves
+        # per-handle submission order.
+        client.call_async(
+            "execute_task",
+            lambda reply: self._on_reply(spec, fut, reply),
+            spec=spec,
+        )
+
+    def _on_reply(self, spec: dict, fut: ResultFuture, reply: dict) -> None:
+        if reply.get("_error") is not None:
+            # Actor worker died (or connection broke) with this call in
+            # flight. The call may already have executed — re-running
+            # would break at-most-once actor semantics, so without
+            # retries it fails like the daemon path fails in-flight
+            # tasks on actor death (reference: actor_task_submitter
+            # DisconnectRpcClient will_retry=false path). Subsequent
+            # calls re-resolve: the daemon's actor_address defers while
+            # the actor restarts and answers with the NEW worker once
+            # ALIVE (or empty if it stays dead).
             self._teardown_client()
-            # Back to resolving: the daemon's actor_address defers
-            # while the actor restarts and answers with the NEW
-            # worker once ALIVE (or empty if it stays dead) — going
-            # daemon-sticky here would race the daemon's own death
-            # detection and strand calls on the dead host's queue.
             self._mode = "resolving"
             if spec.get("max_retries", 0) > 0:
                 spec["max_retries"] -= 1
                 with self._cond:
                     self._queue.insert(0, (spec, fut))
+                    self._cond.notify()
             else:
                 fut.fulfill(None, make_error_payload(
                     "ActorDiedError",

@@ -55,14 +55,19 @@ def make_exception_payload(e: BaseException) -> bytes:
         cause = pickle.dumps(e)
     except Exception:
         cause = None
-    return pickle.dumps(
-        {
-            "kind": "TaskError",
-            "detail": repr(e),
-            "traceback": tb,
-            "cause": cause,
-        }
-    )
+    info = {
+        "kind": "TaskError",
+        "detail": repr(e),
+        "traceback": tb,
+        "cause": cause,
+    }
+    # Generator tasks annotate how many items were sealed before the
+    # failure so consumers can drain them before seeing the error
+    # (object_ref.ObjectRefGenerator mid-stream error protocol).
+    emitted = getattr(e, "__rt_items_emitted__", None)
+    if emitted is not None:
+        info["items_emitted"] = emitted
+    return pickle.dumps(info)
 
 
 def raise_from_payload(payload: bytes) -> None:

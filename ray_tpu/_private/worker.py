@@ -16,6 +16,7 @@ memory and only seal notifications hit the daemon.
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import os
 import queue
@@ -98,6 +99,9 @@ class CoreWorker:
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
         self._actor_pg_context: Optional[dict] = None
+        self._actor_pool = None  # ThreadPoolExecutor, max_concurrency>1
+        self._actor_loop = None  # asyncio loop thread for async methods
+        self._actor_loop_lock = threading.Lock()
         self._running = True
         # Direct task transport (reference: normal_task_submitter.cc
         # worker-to-worker task push). Workers serve a tiny RPC
@@ -358,6 +362,24 @@ class CoreWorker:
             return self.serialization.deserialize(reply["inline"])
         remaining = None if deadline is None else deadline - time.time()
         return self._read_local_store(oid, reply["shm_size"], remaining)
+
+    def peek_object_error(self, oid: ObjectID) -> Optional[bytes]:
+        """Error payload of a KNOWN-READY object, or None if it holds a
+        value. Lets generator consumers inspect a failed completion
+        marker (e.g. for items_emitted) without raising."""
+        if self._direct is not None:
+            entry = self._direct.lookup(oid)
+            if entry is not None:
+                fut = entry[0]
+                if fut.event.is_set() and not fut.daemon_fallback:
+                    return fut.error
+        try:
+            reply = self._client.call(
+                "get_object", oid=oid.binary(), timeout=30.0
+            )
+        except RpcError:
+            return None
+        return reply.get("error")
 
     def _read_local_store(
         self, oid: ObjectID, size: int, timeout: Optional[float]
@@ -621,7 +643,7 @@ class CoreWorker:
         func_key: str,
         args: Sequence[Any],
         name: str = "",
-        num_returns: int = 1,
+        num_returns=1,
         resources: Optional[Dict[str, float]] = None,
         max_retries: int = 0,
         scheduling_strategy: Optional[dict] = None,
@@ -629,8 +651,13 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
+        # Generator tasks ("dynamic"/"streaming") have ONE declared
+        # return — the completion marker; item ids are deterministic
+        # (object_ref.ObjectRefGenerator).
+        mode = num_returns if isinstance(num_returns, str) else None
+        n_declared = 1 if mode else num_returns
         returns = [
-            ObjectID.for_return(task_id, i + 1) for i in range(num_returns)
+            ObjectID.for_return(task_id, i + 1) for i in range(n_declared)
         ]
         spec = {
             "task_id": task_id.binary(),
@@ -645,6 +672,7 @@ class CoreWorker:
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
             "runtime_env": runtime_env,
+            "num_returns_mode": mode,
         }
         if self._direct is not None and self._direct.eligible(spec):
             fut = self._direct.register(spec)
@@ -663,6 +691,7 @@ class CoreWorker:
         namespace: str = "default",
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
+        max_concurrency: int = 1,
         handle_meta: Optional[dict] = None,
         scheduling_strategy: Optional[dict] = None,
         pg_context: Optional[dict] = None,
@@ -683,6 +712,7 @@ class CoreWorker:
             "resources": resources or {"CPU": 1.0},
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
             "handle_meta": handle_meta,
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
@@ -696,12 +726,14 @@ class CoreWorker:
         actor_id: ActorID,
         method: str,
         args: Sequence[Any],
-        num_returns: int = 1,
+        num_returns=1,
         max_retries: int = 0,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
+        mode = num_returns if isinstance(num_returns, str) else None
+        n_declared = 1 if mode else num_returns
         returns = [
-            ObjectID.for_return(task_id, i + 1) for i in range(num_returns)
+            ObjectID.for_return(task_id, i + 1) for i in range(n_declared)
         ]
         spec = {
             "task_id": task_id.binary(),
@@ -715,6 +747,7 @@ class CoreWorker:
             "resources": {},
             "actor_id": actor_id.binary(),
             "max_retries": max_retries,
+            "num_returns_mode": mode,
         }
         if self._direct is not None:
             fut = self._direct.register(spec)
@@ -725,14 +758,18 @@ class CoreWorker:
         return [ObjectRef(r, owner=self) for r in returns]
 
     def _actor_router(self, actor_id: ActorID):
-        router = self._actor_routers.get(actor_id)
-        if router is None:
-            from .direct import ActorDirectRouter
+        # Locked check-then-create: a lost setdefault race would leak
+        # the loser's router thread (started in its __init__), parked
+        # forever on an empty queue.
+        with self._ref_lock:
+            router = self._actor_routers.get(actor_id)
+            if router is None:
+                from .direct import ActorDirectRouter
 
-            router = self._actor_routers.setdefault(
-                actor_id, ActorDirectRouter(self, actor_id)
-            )
-        return router
+                router = self._actor_routers[actor_id] = (
+                    ActorDirectRouter(self, actor_id)
+                )
+            return router
 
     # ------------------------------------------------------------------
     # misc API
@@ -770,7 +807,38 @@ class CoreWorker:
             if item is None:
                 return
             spec, reply_to = item
-            self._execute(spec, reply_to)
+            if (
+                self._actor_pool is not None
+                and spec.get("kind") == "actor_task"
+            ):
+                # Concurrent actor: the loop thread only dispatches;
+                # up to max_concurrency method calls run on the pool
+                # (task context is thread-local, replies are
+                # send-locked, so pool threads are safe).
+                self._actor_pool.submit(self._execute, spec, reply_to)
+            else:
+                self._execute(spec, reply_to)
+
+    def _run_coroutine(self, coro):
+        """Execute an async actor method to completion on the actor's
+        shared event loop (created on first use). The calling pool
+        thread blocks for the result, so max_concurrency bounds
+        concurrent coroutines while awaits interleave on the loop."""
+        import asyncio
+
+        with self._actor_loop_lock:
+            if self._actor_loop is None:
+                loop = asyncio.new_event_loop()
+                thread = threading.Thread(
+                    target=loop.run_forever,
+                    name="rt-actor-asyncio",
+                    daemon=True,
+                )
+                thread.start()
+                self._actor_loop = loop
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._actor_loop
+        ).result()
 
     def _direct_reply(self, reply_to, payload: dict) -> None:
         conn, mid = reply_to
@@ -834,6 +902,22 @@ class CoreWorker:
                     self._actor_instance = cls(*args, **kwargs)
                     self._actor_id = ActorID(spec["actor_id"])
                     self._actor_pg_context = spec.get("pg_context")
+                    concurrency = int(spec.get("max_concurrency") or 1)
+                    if concurrency > 1:
+                        # Concurrent actor (reference: concurrency_
+                        # group_manager.h / threaded+async actors):
+                        # method calls dispatch to a pool of N threads;
+                        # coroutine-returning methods additionally run
+                        # on a shared event loop so they can await each
+                        # other while the pool bounds concurrency.
+                        import concurrent.futures
+
+                        self._actor_pool = (
+                            concurrent.futures.ThreadPoolExecutor(
+                                max_workers=concurrency,
+                                thread_name_prefix="rt-actor-exec",
+                            )
+                        )
                     results = [None]
                 elif kind == "actor_task":
                     if self._actor_instance is None:
@@ -852,20 +936,20 @@ class CoreWorker:
                             self._actor_instance, spec["method"]
                         )
                         value = method(*args, **kwargs)
-                    results = self._split_returns(
-                        value, len(spec["returns"])
-                    )
+                        if inspect.iscoroutine(value):
+                            value = self._run_coroutine(value)
+                    results = self._collect_returns(task_id, spec, value)
                 else:
                     func = self.functions.fetch(spec["function_key"])
                     value = func(*args, **kwargs)
-                    results = self._split_returns(
-                        value, len(spec["returns"])
-                    )
+                    results = self._collect_returns(task_id, spec, value)
         except BaseException as e:  # noqa: BLE001 — any task failure
             payload = make_exception_payload(e)
             if reply_to is not None:
-                self._direct_reply(reply_to, {"error": payload})
+                # Events before the reply: a state/timeline query
+                # issued the moment get() unblocks should see the task.
                 self._report_direct_task_events(spec, start_time, True)
+                self._direct_reply(reply_to, {"error": payload})
             else:
                 self._client.notify(
                     "task_done",
@@ -899,13 +983,13 @@ class CoreWorker:
                         )
                         wire.append(("shm", used))
             except BaseException as e:  # noqa: BLE001
+                self._report_direct_task_events(spec, start_time, True)
                 self._direct_reply(reply_to, {"error": make_error_payload(
                     "TaskError", f"failed to store results: {e!r}"
                 )})
-                self._report_direct_task_events(spec, start_time, True)
                 return
-            self._direct_reply(reply_to, {"results": wire})
             self._report_direct_task_events(spec, start_time, False)
+            self._direct_reply(reply_to, {"results": wire})
             return
         try:
             for oid_bytes, value in zip(spec["returns"], results):
@@ -930,6 +1014,41 @@ class CoreWorker:
             else:
                 args.append(self._get_one(ObjectID(payload), timeout=None))
         return args
+
+    def _collect_returns(
+        self, task_id: TaskID, spec: dict, value: Any
+    ) -> List[Any]:
+        """Normal returns are split across the declared return ids;
+        generator tasks ("dynamic"/"streaming") seal each yielded item
+        under its deterministic id as produced, then return the
+        completion marker (an ObjectRefGenerator carrying the count)
+        as the single declared return (reference:
+        python/ray/_raylet.pyx streaming generator protocol)."""
+        mode = spec.get("num_returns_mode")
+        if not mode:
+            return self._split_returns(value, len(spec["returns"]))
+        if not hasattr(value, "__iter__") and not hasattr(
+            value, "__next__"
+        ):
+            raise TypeError(
+                f"num_returns={mode!r} requires the task to return a "
+                f"generator or iterable, got {type(value).__name__}"
+            )
+        from ..object_ref import ObjectRefGenerator
+
+        count = 0
+        try:
+            for item in value:
+                self.put_object(
+                    ObjectID.for_return(task_id, count + 2), item
+                )
+                count += 1
+        except BaseException as e:
+            # Consumers must still receive the items sealed before the
+            # failure; the error payload carries the emitted count.
+            e.__rt_items_emitted__ = count
+            raise
+        return [ObjectRefGenerator(task_id, count=count)]
 
     @staticmethod
     def _split_returns(value: Any, num_returns: int) -> List[Any]:  # noqa: D102

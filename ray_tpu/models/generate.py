@@ -216,3 +216,60 @@ def generate(
     tokens = tokens.T  # [b, max_new_tokens]
     lengths = jnp.sum(alive_flags.T.astype(jnp.int32), axis=1)
     return tokens, lengths
+
+
+def generate_stream(
+    params: Dict[str, Any],
+    prompt_tokens: jax.Array,
+    prompt_lengths: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int = 64,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    eos_token: int = -1,
+    rng: Optional[jax.Array] = None,
+):
+    """Incremental analog of `generate`: yields one `[b]` int token
+    array per decode step, as sampled — the producer side of token
+    streaming (`num_returns="streaming"` actor methods hand each step
+    to consumers while decoding continues). Trades the scan-fused
+    decode loop for per-step dispatch of a single jitted step, so
+    time-to-first-token is one prefill + one step instead of the whole
+    budget. Stops early when every row has emitted `eos_token`."""
+    import numpy as np
+
+    b, prompt_len = prompt_tokens.shape
+    max_len = prompt_len + max_new_tokens
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, max_len)
+
+    logits, cache = _forward_with_cache(
+        params, cfg, prompt_tokens, cache,
+        jnp.int32(0), jnp.int32(prompt_len),
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+
+    @jax.jit
+    def one_step(params, cache, last_logits, position, alive, key):
+        token = _sample(last_logits, key, temperature, top_k)
+        token = jnp.where(alive, token, 0)
+        logits, cache = _forward_with_cache(
+            params, cfg, token[:, None], cache, position, position + 1
+        )
+        return token, cache, logits[:, 0], alive & (token != eos_token)
+
+    alive = jnp.ones(b, bool)
+    position = jnp.int32(prompt_len)
+    for key in jax.random.split(rng, max_new_tokens):
+        token, cache, last, alive = one_step(
+            params, cache, last, position, alive, key
+        )
+        yield np.asarray(token)  # device->host sync per step
+        position = position + 1
+        # Post-step mask: once every row has emitted EOS there is no
+        # token left to produce — stop without dispatching a dead step.
+        if not np.asarray(alive).any():
+            return

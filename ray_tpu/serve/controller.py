@@ -100,6 +100,13 @@ class ServeController:
             replica_id = f"{app}#{spec['name']}#{self._replica_seq}"
         options = dict(spec.get("actor_options") or {})
         options.setdefault("num_cpus", 1)
+        # Replicas interleave requests up to max_ongoing_requests via
+        # actor concurrency (reference: serve replicas are async actors
+        # bounded by max_ongoing_requests) — before max_concurrency
+        # existed, batching had to live handle-side in the router.
+        options.setdefault(
+            "max_concurrency", int(spec.get("max_ongoing_requests") or 8)
+        )
         actor_cls = self._rt.remote(**options)(Replica)
         handle = actor_cls.remote(
             cloudpickle.loads(spec["cls_blob"]),

@@ -9,6 +9,7 @@ composed models call downstream deployments through the router.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any
 
@@ -43,10 +44,17 @@ class Replica:
         self._instance = cls(*args, **kwargs)
         self.replica_id = replica_id
         self._served = 0
+        # Replicas run with max_concurrency > 1 (controller wires
+        # max_ongoing_requests through actor concurrency), so replica
+        # bookkeeping must be thread-safe; the USER instance is
+        # responsible for its own state under concurrent methods, as
+        # in the reference's async replicas.
+        self._served_lock = threading.Lock()
         self._started = time.time()
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
-        self._served += 1
+        with self._served_lock:
+            self._served += 1
         target = (
             self._instance
             if method == "__call__"
@@ -59,7 +67,8 @@ class Replica:
     def handle_batch(self, method: str, batched_args: list):
         """One call carrying many requests; the user method receives
         the list (reference: serve/batching.py _BatchQueue)."""
-        self._served += len(batched_args)
+        with self._served_lock:
+            self._served += len(batched_args)
         target = getattr(self._instance, method)
         return target([a[0] if len(a) == 1 else a for a in batched_args])
 

@@ -1,0 +1,168 @@
+"""Generator returns (num_returns="dynamic"/"streaming") and
+concurrent actors (max_concurrency, async methods).
+
+Reference behavior matched: python/ray/remote_function.py:385-391
+(dynamic/streaming num_returns), python/ray/_raylet.pyx:269
+(ObjectRefGenerator), src/ray/core_worker/transport/
+concurrency_group_manager.h (threaded/async actors)."""
+
+import time
+
+import pytest
+
+
+def test_dynamic_generator(rt_session):
+    rt = rt_session
+
+    @rt.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    ref = gen.remote(5)
+    g = rt.get(ref, timeout=20)
+    assert isinstance(g, rt.ObjectRefGenerator)
+    assert [rt.get(r, timeout=10) for r in g] == [0, 10, 20, 30, 40]
+
+
+def test_streaming_generator_incremental(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    def warm():
+        return None
+
+    rt.get(warm.remote(), timeout=30)  # pay worker spawn outside timing
+
+    @rt.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            time.sleep(0.05)
+            yield i
+
+    t0 = time.monotonic()
+    first_at = None
+    got = []
+    for r in gen.remote(4):
+        got.append(rt.get(r, timeout=10))
+        if first_at is None:
+            first_at = time.monotonic() - t0
+    assert got == [0, 1, 2, 3]
+    # First item arrives while the task is still producing.
+    assert first_at < 0.15, first_at
+
+
+def test_streaming_generator_empty_and_error(rt_session):
+    rt = rt_session
+
+    @rt.remote(num_returns="streaming")
+    def empty():
+        return iter(())
+
+    assert list(empty.remote()) == []
+
+    @rt.remote(num_returns="streaming")
+    def boom():
+        yield 1
+        raise ValueError("midstream")
+
+    it = iter(boom.remote())
+    assert rt.get(next(it), timeout=10) == 1
+    with pytest.raises(ValueError, match="midstream"):
+        for r in it:
+            rt.get(r, timeout=10)
+
+
+def test_streaming_non_generator_rejected(rt_session):
+    rt = rt_session
+
+    @rt.remote(num_returns="dynamic")
+    def not_gen():
+        return 42
+
+    with pytest.raises(TypeError, match="generator"):
+        rt.get(rt.get(not_gen.remote(), timeout=10))
+
+    with pytest.raises(ValueError, match="num_returns"):
+
+        @rt.remote(num_returns="bogus")
+        def bad():
+            yield 1
+
+        bad.remote()
+
+
+def test_actor_streaming_method(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Tok:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    a = Tok.remote()
+    out = [
+        rt.get(r, timeout=10)
+        for r in a.tokens.options(num_returns="streaming").remote(3)
+    ]
+    assert out == ["tok0", "tok1", "tok2"]
+
+
+def test_threaded_actor_concurrency(rt_session):
+    rt = rt_session
+
+    @rt.remote(max_concurrency=4)
+    class Par:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    a = Par.remote()
+    rt.get(a.work.remote(0.01), timeout=30)  # warm
+    t0 = time.monotonic()
+    rt.get([a.work.remote(0.3) for _ in range(4)], timeout=30)
+    assert time.monotonic() - t0 < 0.9  # concurrent, not 1.2s serial
+
+
+def test_async_actor_methods(rt_session):
+    rt = rt_session
+
+    @rt.remote(max_concurrency=4)
+    class Async:
+        async def sleepy(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return t
+
+        async def add(self, a, b):
+            return a + b
+
+    a = Async.remote()
+    assert rt.get(a.add.remote(2, 3), timeout=30) == 5
+    t0 = time.monotonic()
+    out = rt.get([a.sleepy.remote(0.3) for _ in range(4)], timeout=30)
+    assert out == [0.3] * 4
+    assert time.monotonic() - t0 < 0.9
+
+
+def test_serial_actor_stays_serial(rt_session):
+    rt = rt_session
+
+    @rt.remote
+    class Serial:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        def work(self):
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            time.sleep(0.05)
+            self.active -= 1
+            return self.max_active
+
+    a = Serial.remote()
+    results = rt.get([a.work.remote() for _ in range(5)], timeout=30)
+    assert max(results) == 1  # never interleaved

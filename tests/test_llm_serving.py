@@ -70,3 +70,63 @@ def test_llm_deployment_with_batching(rt_session):
         assert again == results[0]
     finally:
         serve.shutdown()
+
+
+def test_llm_token_streaming(rt_session):
+    """Token streaming: an engine actor decodes with generate_stream
+    and yields each step through a streaming generator — the consumer
+    receives tokens while decoding is still running (reference story:
+    streaming chat completions; transport:
+    num_returns='streaming' + models/generate.generate_stream)."""
+    rt = rt_session
+
+    @rt.remote
+    class Engine:
+        def __init__(self):
+            from ray_tpu.models.llama import LlamaConfig, init_params
+
+            self.cfg = LlamaConfig(
+                vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=4, intermediate=128, max_seq_len=64,
+                dtype=jnp.float32, attention="reference",
+            )
+            self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+        def stream(self, prompt, max_new_tokens):
+            from ray_tpu.models.generate import generate_stream
+
+            batch = jnp.asarray([prompt], jnp.int32)
+            lengths = jnp.asarray([len(prompt)], jnp.int32)
+            for step_tokens in generate_stream(
+                self.params, batch, lengths, self.cfg,
+                max_new_tokens=max_new_tokens, temperature=0.0,
+            ):
+                yield int(step_tokens[0])
+
+    engine = Engine.remote()
+    gen = engine.stream.options(num_returns="streaming").remote(
+        [1, 7, 12, 5], 6
+    )
+    tokens = [rt.get(r, timeout=60) for r in gen]
+    assert len(tokens) == 6
+    assert all(0 <= t < 128 for t in tokens)
+
+    # Greedy decode must match the batch (scan) path token-for-token.
+    from ray_tpu.models.generate import generate
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        intermediate=128, max_seq_len=64, dtype=jnp.float32,
+        attention="reference",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    out, _ = generate(
+        params,
+        jnp.asarray([[1, 7, 12, 5]], jnp.int32),
+        jnp.asarray([4], jnp.int32),
+        cfg,
+        max_new_tokens=6,
+        temperature=0.0,
+    )
+    assert tokens == np.asarray(out)[0].tolist()
