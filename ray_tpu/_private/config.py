@@ -90,6 +90,11 @@ class Config:
     heartbeat_interval_s: float = 0.25
 
     # ---- fault tolerance ----
+    #: Persist head control-plane tables (KV, jobs, nodes, actors) to
+    #: an op log in the session dir; a head restarted over the same
+    #: session replays it and worker nodes resync (reference: GCS over
+    #: a Redis store client + HandleNotifyGCSRestart resync).
+    gcs_fault_tolerance: bool = True
     #: Default max retries for tasks (reference: task default 3).
     task_max_retries: int = 3
     #: Default max restarts for actors.

@@ -302,12 +302,16 @@ class NodeDaemon:
             "release_lease",
             "actor_address",
             "task_event",
+            # head fault tolerance
+            "node_resync",
         ]:
             self.server.register(name, getattr(self, "_h_" + name))
         self.server.register("_disconnect", self._h_disconnect)
 
         if is_head:
             self.control = ControlState(config.task_events_max_buffer)
+            if config.gcs_fault_tolerance:
+                self._restore_control_state()
             self.control.register_node(
                 NodeInfo(
                     node_id=self.node_id,
@@ -322,8 +326,57 @@ class NodeDaemon:
             assert head_address, "worker node needs head_address"
             self.head_address = head_address
 
+    def _restore_control_state(self) -> None:
+        """Head fault tolerance (reference: GCS restart over its Redis
+        store, node_manager.cc:1189 HandleNotifyGCSRestart): replay the
+        session's op log into the control tables and resurrect actor
+        runtime records; worker nodes re-register and resync via their
+        heartbeat loop when they notice the new head."""
+        from .gcs import StateLog
+
+        log_path = os.path.join(self.session_dir, "gcs_oplog.bin")
+        ops = StateLog.replay(log_path)
+        extra = self.control.restore(ops) if ops else []
+        self._restored_pending_creations = []
+        for op in extra:
+            if op[0] != "actor_spec":
+                continue
+            spec = op[1]
+            actor_id = ActorID(spec["actor_id"])
+            info = self.control.actors.get(actor_id)
+            if info is None or info.state == ACTOR_DEAD:
+                continue
+            runtime = ActorRuntime(creation_spec=spec, info=info)
+            if info.node_id is not None:
+                runtime.node = info.node_id.binary()
+            self.actor_runtimes[actor_id] = runtime
+            if info.state in (
+                ACTOR_PENDING_CREATION, ACTOR_RESTARTING,
+            ):
+                # Creation was in flight when the head died; the
+                # scheduler queue was memory-only, so it must be
+                # re-dispatched (after start(), when listeners are up).
+                # _h_schedule_task's already-hosting guard keeps a
+                # surviving node that finished the creation from
+                # getting a duplicate instance.
+                self._restored_pending_creations.append(spec)
+        self.control.log = StateLog(log_path)
+
+    def _redispatch_restored_creations(self) -> None:
+        for spec in getattr(self, "_restored_pending_creations", ()):
+            task_id = TaskID(spec["task_id"])
+            with self._lock:
+                self.tasks[task_id] = TaskEntry(spec=spec)
+            try:
+                self._submit_cluster(spec)
+            except Exception:
+                pass
+        self._restored_pending_creations = []
+
     def start(self) -> None:
         self.server.start()
+        if self.is_head:
+            self._redispatch_restored_creations()
         if self.config.memory_monitor_refresh_ms > 0:
             from .memory_monitor import MemoryMonitor
 
@@ -423,15 +476,19 @@ class NodeDaemon:
     def _h_node_heartbeat(self, conn, msg):
         node_id = NodeID(msg["node_id"])
         info = self.control.nodes.get(node_id)
-        if info is not None:
-            info.last_heartbeat = time.time()
-            info.available = dict(msg.get("available") or {})
-            info.queued = int(msg.get("queued", 0))
-            # Totals change when placement-group bundles commit/release
-            # (group resources are added to the node pool).
-            total = msg.get("total")
-            if total is not None:
-                info.resources = dict(total)
+        if info is None:
+            # Head restarted without state for this node (or the node
+            # outlived a mark-dead): ask it to re-register + resync.
+            return {"ok": False, "unknown_node": True}
+        info.last_heartbeat = time.time()
+        info.alive = True  # a heartbeating node is alive
+        info.available = dict(msg.get("available") or {})
+        info.queued = int(msg.get("queued", 0))
+        # Totals change when placement-group bundles commit/release
+        # (group resources are added to the node pool).
+        total = msg.get("total")
+        if total is not None:
+            info.resources = dict(total)
         # Parked tasks (forward raced a node death, or no feasible node
         # yet) and pending placement groups get another placement
         # attempt on the heartbeat tick.
@@ -450,16 +507,27 @@ class NodeDaemon:
     def _heartbeat_loop(self) -> None:
         while not self._shutdown:
             try:
-                self.head.call(
+                reply = self.head.call(
                     "node_heartbeat",
                     node_id=self.node_id.binary(),
                     available=self.scheduler.available().to_dict(),
                     total=self.scheduler.total().to_dict(),
                     queued=self.scheduler.queued_count(),
+                    timeout=10.0,
                 )
+                if reply.get("unknown_node"):
+                    self._resync_with_head()
             except Exception:
                 if self._shutdown:
                     return
+                # Head connection lost — likely a head restart
+                # (reference: raylet resync on HandleNotifyGCSRestart,
+                # node_manager.cc:1189). Re-register and re-report our
+                # live actors + sealed objects once it is back.
+                try:
+                    self._resync_with_head()
+                except Exception:
+                    pass
             # Reclaim arena reader pins of crashed/OOM-killed workers so
             # their slots become evictable again (plasma reclaims on
             # client disconnect; the serverless arena uses pid liveness).
@@ -470,6 +538,60 @@ class NodeDaemon:
                 except Exception:
                     pass
             time.sleep(self.config.heartbeat_interval_s)
+
+    def _resync_with_head(self) -> None:
+        """Re-attach to a (possibly restarted) head: re-register this
+        node and re-report locally-hosted actors and sealed objects so
+        the head's directory is rebuilt (reference: raylet-side state
+        report after HandleNotifyGCSRestart)."""
+        self.head.call(
+            "register_node",
+            node_id=self.node_id.binary(),
+            address=self.address,
+            resources=self.resources,
+            labels=self.labels,
+            retries=5,
+            timeout=10.0,
+        )
+        with self._lock:
+            actors = [aid.binary() for aid in self.actor_hosts]
+            objects = [
+                (oid.binary(), entry.size)
+                for oid, entry in self.objects.items()
+                if entry.in_shm and entry.state == SEALED
+            ]
+        self.head.call(
+            "node_resync",
+            node_id=self.node_id.binary(),
+            actors=actors,
+            objects=objects,
+            timeout=10.0,
+        )
+
+    def _h_node_resync(self, conn, msg):
+        """A worker node re-reports its live state after a head
+        restart (head only)."""
+        node_id = msg["node_id"]
+        for actor_binary in msg.get("actors", ()):
+            actor_id = ActorID(actor_binary)
+            with self._lock:
+                runtime = self.actor_runtimes.get(actor_id)
+            if runtime is None or runtime.info.state == ACTOR_DEAD:
+                continue
+            with self._lock:
+                runtime.node = node_id
+                runtime.info.state = ACTOR_ALIVE
+            self.control.update_actor_state(
+                actor_id, ACTOR_ALIVE, node_id=NodeID(node_id)
+            )
+            self._wake_actor_addr_waiters(actor_id)
+        with self._lock:
+            for oid_binary, size in msg.get("objects", ()):
+                entry = self._ensure_entry(ObjectID(oid_binary))
+                entry.state = SEALED
+                entry.size = size
+                entry.locations.add(node_id)
+        return {"ok": True}
 
     def _h_disconnect(self, conn: Connection, msg: dict):
         if self._shutdown:
@@ -1337,12 +1459,23 @@ class NodeDaemon:
         spec = msg["spec"]
         task_id = TaskID(spec["task_id"])
         with self._lock:
+            if spec["kind"] == "actor_creation":
+                aid = ActorID(spec["actor_id"])
+                host = self.actor_hosts.get(aid)
+                if host is not None:
+                    # Already hosting/creating this actor — a restarted
+                    # head re-dispatched a creation this node finished
+                    # (or still runs). Re-report instead of duplicating
+                    # the instance.
+                    if host.worker_conn_id is not None:
+                        self._control_actor_created(
+                            aid, False, self.node_id.binary()
+                        )
+                    return {}
+                self.actor_hosts[aid] = ActorHost(spec)
             self.tasks[task_id] = TaskEntry(
                 spec=spec, retries_left=spec.get("max_retries", 0)
             )
-            if spec["kind"] == "actor_creation":
-                aid = ActorID(spec["actor_id"])
-                self.actor_hosts.setdefault(aid, ActorHost(spec))
         self.scheduler.enqueue(
             task_id, ResourceSet(spec.get("resources", {})), spec
         )
@@ -1388,6 +1521,10 @@ class NodeDaemon:
             max_restarts=spec.get("max_restarts", 0),
         )
         self.control.register_actor(info)
+        # Creation spec rides the op log so a restarted head can
+        # rebuild this runtime record (and restart the actor if its
+        # host later dies).
+        self.control.log_extra("actor_spec", spec)
         with self._lock:
             self.actor_runtimes[actor_id] = ActorRuntime(
                 creation_spec=spec, info=info

@@ -334,10 +334,22 @@ class RpcServer:
     def close(self) -> None:
         self._closed = True
         for sock, _ in self._listeners:
+            # shutdown() first: close() alone does not release a
+            # listening port while an accept thread is blocked on it
+            # (the in-flight accept pins the open file description, so
+            # the port stays in LISTEN and a restarted server cannot
+            # rebind it).
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:
                 pass
+        for thread in self._accept_threads:
+            if thread.is_alive() and thread is not threading.current_thread():
+                thread.join(timeout=1.0)
         for conn in self.connections():
             conn.close()
         for path in self._unix_paths:
@@ -535,6 +547,8 @@ class RpcClient:
                 if event is not None:
                     self._replies[mid] = msg
                 callback = self._pending_cb.pop(mid, None)
+                if callback is not None:
+                    self._pending_gen.pop(mid, None)
             if event is not None:
                 event.set()
             if callback is not None:

@@ -101,6 +101,37 @@ class Cluster:
             f"cluster did not reach {count} nodes within {timeout}s"
         )
 
+    def crash_head(self) -> "NodeDaemon":
+        """Take the head down abruptly (its control-plane state
+        survives only via the gcs op log in the session dir). Worker
+        nodes keep running; their heartbeat loops will resync once a
+        head is restarted at the same address."""
+        assert self.head is not None
+        head, self.head = self.head, None
+        self._head_resources = dict(head.resources)
+        self._head_address = head.address
+        head.shutdown()
+        return head
+
+    def restart_head(self) -> "NodeDaemon":
+        """Start a fresh head over the SAME session dir (replays the
+        gcs op log) and, for TCP clusters, the same port so surviving
+        nodes and drivers can re-reach it."""
+        assert self.head is None, "head still running"
+        listen_port = 0
+        if self.use_tcp and self._head_address.startswith("tcp://"):
+            listen_port = int(self._head_address.rsplit(":", 1)[1])
+        self.head = NodeDaemon(
+            os.path.join(self.session_dir, "head"),
+            self._head_resources,
+            self.config,
+            is_head=True,
+            listen_host="127.0.0.1" if self.use_tcp else None,
+            listen_port=listen_port,
+        )
+        self.head.start()
+        return self.head
+
     def shutdown(self) -> None:
         for node in self.nodes:
             try:

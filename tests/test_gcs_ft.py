@@ -1,0 +1,119 @@
+"""Head (GCS) fault tolerance: control-plane state survives a head
+crash via the session op log; worker nodes resync with the restarted
+head and actors hosted on them stay callable.
+
+Reference behavior matched: GCS persistence through a store client
+(src/ray/gcs/store_client/redis_store_client.h) + raylet resync on
+head restart (src/ray/raylet/node_manager.cc:1189
+HandleNotifyGCSRestart)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture(params=["unix", "tcp"])
+def ft_cluster(request):
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        initialize_head=True,
+        # Keep the head compute-free so all actors/tasks land on the
+        # worker node (which must survive the head crash).
+        head_resources={"CPU": 0.0},
+        use_tcp=(request.param == "tcp"),
+    )
+    yield c
+    c.shutdown()
+
+
+def test_head_restart_recovers_state(ft_cluster):
+    import ray_tpu as rt
+
+    c = ft_cluster
+    c.add_node(num_cpus=2)
+    rt.init(address=c.address)
+    try:
+
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, x):
+                self.n += x
+                return self.n
+
+        counter = Counter.options(name="survivor").remote()
+        assert rt.get(counter.add.remote(5), timeout=60) == 5
+    finally:
+        rt.shutdown()
+
+    # --- head crashes; the worker node (hosting the actor) survives.
+    c.crash_head()
+    time.sleep(0.3)
+    c.restart_head()
+    # Worker node's heartbeat loop re-registers + resyncs.
+    c.wait_for_nodes(2, timeout=30)
+
+    rt.init(address=c.address)
+    try:
+        # Named actor resolvable from the replayed control tables and
+        # the node resync, with its in-memory state intact.
+        survivor = rt.get_actor("survivor")
+        assert rt.get(survivor.add.remote(1), timeout=60) == 6
+
+        # KV (exported function defs) replayed: new tasks run too.
+        @rt.remote
+        def f(x):
+            return x * 2
+
+        assert rt.get(f.remote(21), timeout=60) == 42
+    finally:
+        rt.shutdown()
+
+
+def test_oplog_replay_tables(tmp_path):
+    """StateLog + ControlState restore round-trip, including a torn
+    tail frame (crash mid-write)."""
+    from ray_tpu._private.gcs import (
+        ACTOR_ALIVE,
+        ActorInfo,
+        ControlState,
+        JobInfo,
+        StateLog,
+    )
+    from ray_tpu._private.ids import ActorID, JobID
+
+    path = str(tmp_path / "oplog.bin")
+    state = ControlState(log=StateLog(path))
+    state.kv_put("ns", "k1", b"v1")
+    state.kv_put("ns", "k2", b"v2")
+    state.kv_del("ns", "k2")
+    job_id = state.next_job_id()
+    state.add_job(JobInfo(job_id=job_id, driver_pid=1, start_time=0.0))
+    actor_id = ActorID(b"a" * ActorID.SIZE)
+    state.register_actor(
+        ActorInfo(
+            actor_id=actor_id,
+            name="named",
+            namespace="default",
+            state=ACTOR_ALIVE,
+            class_name="C",
+        )
+    )
+    state.log.close()
+
+    # Torn tail: simulate a crash mid-append.
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x01\x00garbage")
+
+    restored = ControlState()
+    restored.restore(StateLog.replay(path))
+    assert restored.kv_get("ns", "k1") == b"v1"
+    assert restored.kv_get("ns", "k2") is None
+    assert job_id in restored.jobs
+    info = restored.get_named_actor("default", "named")
+    assert info is not None and info.actor_id == actor_id
+    # Job counter resumes past replayed ids.
+    assert restored.next_job_id().binary() != job_id.binary()
