@@ -45,6 +45,14 @@ class Config:
     object_pull_max_bytes_in_flight: int = 256 * 1024 * 1024
     #: Seconds between object-store eviction scans.
     object_eviction_check_interval_s: float = 1.0
+    #: Spill sealed objects to session-dir files under store pressure
+    #: and restore them on get (reference: local_object_manager.h:110
+    #: SpillObjectsOfSize over external_storage.py FileSystemStorage).
+    object_spilling_enabled: bool = True
+    #: Store-usage fraction above which the daemon spills LRU sealed
+    #: objects to disk (reference: object_spilling_threshold = 0.8,
+    #: ray_config_def.h).
+    object_spilling_threshold: float = 0.8
     #: Use the native C++ arena store (_native/store.cc) instead of
     #: per-object Python shm segments. Reader safety is plasma-style:
     #: atomic pin+view on get, pin-deferred deletion, and dead-reader

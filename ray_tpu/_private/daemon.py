@@ -59,7 +59,8 @@ from .ids import (
     TaskID,
     WorkerID,
 )
-from .object_store import make_store
+from .object_store import ObjectStoreFullError, make_store
+from .spilling import FileSpillStorage
 from .placement_groups import (
     PGEntry,
     STRATEGIES,
@@ -90,6 +91,10 @@ class ObjectEntry:
     meta_waiters: List[tuple] = field(default_factory=list)
     pulling: bool = False
     reconstructing: bool = False
+    #: Data written to this node's spill storage; the shm copy may be
+    #: gone but the object is still servable locally (reference:
+    #: ObjectTableData spilled_url, gcs.proto).
+    spilled: bool = False
 
 
 @dataclass
@@ -173,6 +178,20 @@ class NodeDaemon:
             on_evict=self._on_store_evict,
             use_native=config.use_native_object_store,
         )
+        self.spill: Optional[FileSpillStorage] = None
+        if config.object_spilling_enabled:
+            self.spill = FileSpillStorage(
+                os.path.join(
+                    session_dir, "spilled_objects", self.node_id.hex()[:8]
+                )
+            )
+        self._spill_lock = threading.Lock()
+        # Primary-copy pins: the daemon holds a read pin on every object
+        # sealed by a local client so LRU eviction can never destroy the
+        # only copy — store-full becomes a spill trigger instead
+        # (reference: raylet pins primary copies via PinObjectIDs,
+        # local_object_manager.h:41; spilling releases the pin).
+        self._primary_pins: Dict[ObjectID, object] = {}
         self.scheduler = LocalScheduler(ResourceSet(resources))
         self.resources = dict(resources)
         self.labels = dict(labels or {})
@@ -302,6 +321,8 @@ class NodeDaemon:
             "release_lease",
             "actor_address",
             "task_event",
+            # object spilling (all nodes)
+            "spill_request",
             # head fault tolerance
             "node_resync",
         ]:
@@ -377,6 +398,11 @@ class NodeDaemon:
         self.server.start()
         if self.is_head:
             self._redispatch_restored_creations()
+        if self.spill is not None:
+            threading.Thread(
+                target=self._spill_loop, daemon=True,
+                name=f"spill:{self.node_id.hex()[:8]}",
+            ).start()
         if self.config.memory_monitor_refresh_ms > 0:
             from .memory_monitor import MemoryMonitor
 
@@ -878,6 +904,9 @@ class NodeDaemon:
                 entry.in_shm = True  # sealed by a local client
             if self.is_head:
                 entry.locations.add(source_node or self.node_id.binary())
+        if source_node is None:
+            # Primary copy: pin against eviction until spilled/deleted.
+            self._pin_primary(oid, msg["size"])
         if not self.is_head and source_node is None:
             # Report our copy to the head's object directory.
             self.head.call(
@@ -1030,7 +1059,7 @@ class NodeDaemon:
         if getattr(self.store, "needs_release", False):
             pin = self.store.acquire(oid, timeout=0.1)
             if pin is None:
-                return {"missing": True}
+                return self._pull_from_spill(oid, offset, length)
             try:
                 total = len(pin.view)
                 chunk = bytes(
@@ -1048,20 +1077,41 @@ class NodeDaemon:
             except FileNotFoundError:
                 view = None
         if view is None:
-            return {"missing": True}
+            return self._pull_from_spill(oid, offset, length)
         total = len(view)
         chunk = bytes(view[offset : min(offset + length, total)])
         return {"data": chunk, "total_size": total}
+
+    def _pull_from_spill(self, oid: ObjectID, offset: int, length: int):
+        """Serve a pull chunk straight from this node's spill file —
+        remote reads need not restore the shm copy first."""
+        if self.spill is not None and self.spill.contains(oid):
+            data = self.spill.read(oid, offset, length)
+            total = self.spill.size(oid)
+            if data is not None and total is not None:
+                return {"data": data, "total_size": total}
+        return {"missing": True}
 
     def _h_delete_object(self, conn, msg):
         """Head tells this node to drop its copy (refcount hit zero)."""
         oid = ObjectID(msg["oid"])
         with self._lock:
             self.objects.pop(oid, None)
-        # unlink_by_id also reaches segments created directly by local
-        # worker processes (the daemon never attached them).
-        self.store.unlink_by_id(oid)
+        self._drop_local_copy(oid)
         return {}
+
+    def _drop_local_copy(self, oid: ObjectID, in_shm: bool = True) -> None:
+        """Release every local holding of one object: the primary pin,
+        the shm segment (unlink_by_id also reaches segments created
+        directly by local worker processes — the daemon never attached
+        them), and any spill file."""
+        self._unpin_primary(oid)
+        if in_shm:
+            self.store.unlink_by_id(oid)
+        else:
+            self.store.delete(oid)
+        if self.spill is not None:
+            self.spill.delete(oid)
 
     def _h_object_evicted(self, conn, msg):
         """A node evicted a cached copy under memory pressure — or, in
@@ -1085,6 +1135,10 @@ class NodeDaemon:
             entry = self.objects.get(oid)
             if entry is not None:
                 entry.in_shm = False
+                if entry.spilled:
+                    # The spill file still serves this object from this
+                    # node — keep the directory location alive.
+                    return
         if self.is_head:
             with self._lock:
                 if entry is not None:
@@ -1097,6 +1151,173 @@ class NodeDaemon:
                 )
             except Exception:
                 pass
+
+    # ------------------------------------------------------------------
+    # object spilling (reference: raylet LocalObjectManager,
+    # local_object_manager.h:110 SpillObjectsOfSize; restore path
+    # AsyncRestoreSpilledObject; storage external_storage.py:72)
+    # ------------------------------------------------------------------
+    def _pin_primary(self, oid: ObjectID, size: int) -> None:
+        """Pin a locally-sealed (primary) copy against eviction."""
+        with self._lock:
+            if oid in self._primary_pins:
+                return
+            self._primary_pins[oid] = None  # reserve against races
+        pin = None
+        if getattr(self.store, "needs_release", False):
+            pin = self.store.acquire(oid, timeout=0)
+        else:
+            if not self.store.contains(oid):
+                try:
+                    self.store.open_remote(oid, size)
+                except FileNotFoundError:
+                    with self._lock:
+                        self._primary_pins.pop(oid, None)
+                    return
+            self.store.pin(oid)
+            pin = oid  # marker: pinned in the py store
+        stale = False
+        with self._lock:
+            if oid not in self._primary_pins:
+                # Object was deleted while we acquired: a concurrent
+                # _unpin_primary consumed the reservation. Inserting
+                # now would leak the pin (and block the arena's
+                # deferred delete) forever — release it instead.
+                stale = True
+            elif pin is None:
+                self._primary_pins.pop(oid, None)
+            else:
+                self._primary_pins[oid] = pin
+        if stale and pin is not None:
+            if getattr(self.store, "needs_release", False):
+                try:
+                    pin.release()
+                except Exception:
+                    pass
+            else:
+                self.store.unpin(oid)
+
+    def _unpin_primary(self, oid: ObjectID) -> None:
+        with self._lock:
+            pin = self._primary_pins.pop(oid, None)
+        if pin is None:
+            return
+        if getattr(self.store, "needs_release", False):
+            try:
+                pin.release()
+            except Exception:
+                pass
+        else:
+            self.store.unpin(oid)
+
+    def _spill_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._maybe_spill()
+            except Exception:
+                pass
+            time.sleep(self.config.object_eviction_check_interval_s)
+
+    def _h_spill_request(self, conn, msg):
+        """A local worker hit store-full on create: synchronously free
+        space by spilling (reference: plasma's create retries after the
+        raylet spills, create_request_queue.h)."""
+        freed = self._maybe_spill(bytes_needed=msg.get("bytes_needed", 0))
+        return {"freed": freed}
+
+    def _maybe_spill(self, bytes_needed: int = 0) -> int:
+        """Spill LRU sealed objects until store usage is back under the
+        spilling threshold (plus `bytes_needed` headroom). Returns the
+        number of bytes freed from the store."""
+        if self.spill is None:
+            return 0
+        with self._spill_lock:
+            info = self.store.size_info()
+            high = self.config.object_spilling_threshold * info["capacity"]
+            target = info["used"] + bytes_needed - high
+            if target <= 0:
+                return 0
+            with self._lock:
+                # Insertion order approximates LRU: oldest sealed local
+                # objects first. Inline and unsealed objects are not
+                # spillable; errored ones have no data.
+                victims = [
+                    (oid, e.size)
+                    for oid, e in self.objects.items()
+                    if e.in_shm and e.state == SEALED and e.inline is None
+                ]
+            freed = 0
+            for oid, size in victims:
+                if freed >= target:
+                    break
+                if self._spill_one(oid, size):
+                    freed += size
+            return freed
+
+    def _spill_one(self, oid: ObjectID, size: int) -> bool:
+        """Write one object's bytes to spill storage, then drop its shm
+        copy. The head keeps this node in the object's location set —
+        the spill file serves pulls and restores."""
+        try:
+            if getattr(self.store, "needs_release", False):
+                pin = self.store.acquire(oid, timeout=0)
+                if pin is None:
+                    return False
+                try:
+                    self.spill.spill(oid, pin.view)
+                finally:
+                    pin.release()
+            else:
+                view = self.store.get(oid, timeout=0)
+                if view is None:
+                    # Segment created by a local worker process; attach.
+                    try:
+                        view = self.store.open_remote(oid, size)
+                    except FileNotFoundError:
+                        return False
+                self.spill.spill(oid, view)
+        except Exception:
+            return False
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is None:
+                # Deleted concurrently; drop the orphan file.
+                self.spill.delete(oid)
+                return False
+            entry.spilled = True
+            entry.in_shm = False
+        self._unpin_primary(oid)
+        self.store.unlink_by_id(oid)
+        return True
+
+    def _restore_spilled(self, oid: ObjectID) -> bool:
+        """Copy a spilled object back into the shm store so local
+        consumers map it zero-copy again."""
+        if self.spill is None:
+            return False
+        data = self.spill.read(oid)
+        if data is None:
+            return False
+        try:
+            try:
+                self.store.put(oid, data)
+            except ObjectStoreFullError:
+                # Make room by spilling colder objects, then retry once.
+                self._maybe_spill(bytes_needed=len(data))
+                self.store.put(oid, data)
+        except ValueError:
+            pass  # already (re-)created by a concurrent restore
+        except ObjectStoreFullError:
+            return False
+        with self._lock:
+            entry = self._ensure_entry(oid)
+            entry.in_shm = True
+            entry.size = len(data)
+            entry.state = SEALED
+            if self.is_head:
+                entry.locations.add(self.node_id.binary())
+        self._pin_primary(oid, len(data))
+        return True
 
     # -- cross-node pull -------------------------------------------------
     def _ensure_local(self, oid: ObjectID) -> None:
@@ -1126,6 +1347,15 @@ class NodeDaemon:
             self._schedule()
 
     def _pull_once(self, oid: ObjectID) -> None:
+        # Restore-from-spill fast path: the data never left this node's
+        # disk (reference: AsyncRestoreSpilledObject before remote pull,
+        # local_object_manager.h).
+        if (
+            self.spill is not None
+            and self.spill.contains(oid)
+            and self._restore_spilled(oid)
+        ):
+            return
         for attempt in range(5):
             if self.is_head:
                 meta = self._meta_reply(oid)
@@ -1157,6 +1387,18 @@ class NodeDaemon:
                 if nid != self.node_id.binary()
             ]
             if not locations:
+                # A local spill file outranks reconstruction: an earlier
+                # restore may have failed only because the store was
+                # momentarily too full (finding: restore-fail must not
+                # look like data loss while the bytes sit on this disk).
+                if (
+                    self.spill is not None
+                    and self.spill.contains(oid)
+                ):
+                    if self._restore_spilled(oid):
+                        return
+                    time.sleep(0.2 * (attempt + 1))
+                    continue
                 if self.is_head:
                     self._maybe_reconstruct(oid)
                     return
@@ -1303,11 +1545,7 @@ class NodeDaemon:
                     to_delete.append((oid, entry.in_shm, remote_locs))
                     del self.objects[oid]
         for oid, in_shm, remote_locs in to_delete:
-            # Clients create segments directly; the daemon owns unlink.
-            if in_shm:
-                self.store.unlink_by_id(oid)
-            else:
-                self.store.delete(oid)
+            self._drop_local_copy(oid, in_shm=in_shm)
             for nid in remote_locs:
                 client = self._node_client(nid)
                 if client is not None:
@@ -2750,6 +2988,8 @@ class NodeDaemon:
             return self.head.call("state_summary")
         summary = self.control.summary()
         summary.update(self.store.size_info())
+        if self.spill is not None:
+            summary.update(self.spill.stats())
         with self._lock:
             summary["workers"] = len(self.workers)
             summary["queued_tasks"] = self.scheduler.queued_count()
@@ -3030,9 +3270,13 @@ class NodeDaemon:
             shm_oids = [
                 oid for oid, e in self.objects.items() if e.in_shm
             ]
-        for oid in shm_oids:
-            self.store.unlink_by_id(oid)
+        with self._lock:
+            pinned = list(self._primary_pins)
+        for oid in set(pinned) | set(shm_oids):
+            self._drop_local_copy(oid)
         self.store.shutdown()
+        if self.spill is not None:
+            self.spill.shutdown()
 
 
 class _CallbackConn:

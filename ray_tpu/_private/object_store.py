@@ -95,7 +95,13 @@ class SharedMemoryStore:
     the same pages. Objects are immutable after seal.
     """
 
-    def __init__(self, node_id_hex: str, capacity: int, on_evict=None):
+    def __init__(
+        self,
+        node_id_hex: str,
+        capacity: int,
+        on_evict=None,
+        evict_enabled: bool = True,
+    ):
         self._prefix = f"rt_{node_id_hex[:8]}_"
         self._capacity = capacity
         self._used = 0
@@ -105,6 +111,13 @@ class SharedMemoryStore:
         # Called with each evicted ObjectID so the owning daemon can fix
         # its object table / tell the control plane a copy is gone.
         self._on_evict = on_evict
+        # Worker/driver instances must NOT evict: bookkeeping is
+        # per-process, so a client-side LRU pass could destroy a
+        # primary copy the daemon believes is pinned. Clients raise
+        # ObjectStoreFullError instead; the daemon spills, and the
+        # client reclaims accounting for the vanished segments via
+        # _sweep_unlinked.
+        self._evict_enabled = evict_enabled
 
     # -- producer side ---------------------------------------------------
     def create(self, object_id: ObjectID, size: int) -> memoryview:
@@ -113,6 +126,8 @@ class SharedMemoryStore:
             if object_id in self._entries:
                 raise ValueError(f"Object {object_id} already exists")
             if self._used + size > self._capacity:
+                self._sweep_unlinked()
+            if self._used + size > self._capacity and self._evict_enabled:
                 self._evict(self._used + size - self._capacity)
             if self._used + size > self._capacity:
                 raise ObjectStoreFullError(
@@ -269,6 +284,20 @@ class SharedMemoryStore:
                     self._on_evict(oid)
                 except Exception:
                     pass
+
+    def _sweep_unlinked(self) -> None:
+        """Reclaim accounting for segments whose backing /dev/shm file
+        is gone — the daemon spilled or deleted them; this process's
+        per-instance bookkeeping just hasn't heard (caller holds lock).
+        Pages stay alive for any live zero-copy views; only the
+        capacity charge is dropped."""
+        for oid in list(self._entries):
+            entry = self._entries[oid]
+            name = entry.shm._name.lstrip("/")  # noqa: SLF001
+            if not os.path.exists("/dev/shm/" + name):
+                del self._entries[oid]
+                self._used -= entry.size
+                _close_shm(entry.shm)
 
     def _name(self, object_id: ObjectID) -> str:
         return self._prefix + object_id.hex()
@@ -464,9 +493,12 @@ def make_store(
     capacity: int,
     on_evict=None,
     use_native: bool = False,
+    client: bool = False,
 ):
     """Store factory: native arena when requested and buildable, else
-    the per-segment Python store."""
+    the per-segment Python store. `client=True` marks worker/driver
+    instances, whose py-store bookkeeping is per-process and must never
+    LRU-evict (the daemon owns eviction and spilling)."""
     if use_native:
         try:
             return NativeArenaStore(
@@ -474,4 +506,7 @@ def make_store(
             )
         except Exception:
             pass
-    return SharedMemoryStore(node_id_hex, capacity, on_evict=on_evict)
+    return SharedMemoryStore(
+        node_id_hex, capacity, on_evict=on_evict,
+        evict_enabled=not client,
+    )

@@ -29,7 +29,7 @@ from ..object_ref import ObjectRef
 from .config import Config
 from .function_manager import FunctionManager
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
-from .object_store import make_store
+from .object_store import ObjectStoreFullError, make_store
 from .rpc import RpcClient, RpcError
 from .serialization import SerializationContext
 from .task_spec import (
@@ -146,6 +146,7 @@ class CoreWorker:
             reply["store_capacity"],
             on_evict=self._notify_store_evict,
             use_native=self.config.use_native_object_store,
+            client=True,
         )
         self.serialization = SerializationContext(ref_class=ObjectRef)
         self.functions = FunctionManager(self._client)
@@ -272,6 +273,19 @@ class CoreWorker:
         self.put_object(oid, value, cache=True)
         return ObjectRef(oid, owner=self)
 
+    def _store_create(self, oid: ObjectID, size: int) -> memoryview:
+        """create() with spill-on-full: if the store can't make room by
+        evicting, ask the daemon to spill cold objects to disk and retry
+        (reference: plasma create retries after the raylet spills,
+        create_request_queue.h)."""
+        try:
+            return self.store.create(oid, size)
+        except ObjectStoreFullError:
+            self._client.call(
+                "spill_request", bytes_needed=size, timeout=60.0
+            )
+            return self.store.create(oid, size)
+
     def put_object(
         self, oid: ObjectID, value: Any, cache: bool = False
     ) -> Tuple[str, Any]:
@@ -295,7 +309,7 @@ class CoreWorker:
         # Large object: flush deferred ref-drops first so the daemon's
         # eviction view is current when space is tight.
         self.flush_pending_dels()
-        buf = self.store.create(oid, size)
+        buf = self._store_create(oid, size)
         used = serialized.write_to(buf)
         self.store.seal(oid)
         self._client.call("object_sealed", oid=oid.binary(), size=used)
@@ -589,7 +603,7 @@ class CoreWorker:
                 # Large plain arg: promoted to a put + ref (reference:
                 # DependencyResolver inlining threshold).
                 oid = self._next_put_id()
-                buf = self.store.create(oid, size)
+                buf = self._store_create(oid, size)
                 used = serialized.write_to(buf)
                 self.store.seal(oid)
                 self._client.call(
@@ -975,7 +989,7 @@ class CoreWorker:
                         wire.append(("inline", serialized.to_bytes()))
                     else:
                         oid = ObjectID(oid_bytes)
-                        buf = self.store.create(oid, size)
+                        buf = self._store_create(oid, size)
                         used = serialized.write_to(buf)
                         self.store.seal(oid)
                         self._client.call(
