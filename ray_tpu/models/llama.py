@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import flash_attention, mha_reference, repeat_kv
+from ..ops.moe import moe_ffn_dense, moe_ffn_ep
 from ..ops.norms import apply_rotary, rms_norm, rotary_embedding, swiglu
 from ..ops.ring_attention import ring_attention
 from ..parallel.sharding import Annotated, annotate
@@ -49,6 +50,15 @@ class LlamaConfig:
     # TPU LLM trade — near-"none" speed at a fraction of the memory);
     # ignored when remat=False.
     remat_policy: str = "full"  # full | dots
+    # ---- mixture of experts ----
+    #: >0 turns every FFN into a top-k-routed MoE with this many
+    #: experts (0 = dense SwiGLU). Experts shard over the `ep` mesh
+    #: axis when an ep_axis is passed (shard_map) — SURVEY §2.4 EP row.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    #: Weight of the Switch/GShard load-balancing auxiliary loss.
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -56,11 +66,17 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         embed = self.vocab_size * self.dim
+        if self.moe_experts:
+            ffn = self.dim * self.moe_experts + (
+                2 * self.moe_experts * self.dim * self.intermediate
+            )  # router + per-expert in/out
+        else:
+            ffn = 3 * self.dim * self.intermediate  # w1, w2, w3
         per_layer = (
             self.dim * self.n_heads * self.head_dim  # wq
             + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
             + self.n_heads * self.head_dim * self.dim  # wo
-            + 3 * self.dim * self.intermediate  # w1, w2, w3
+            + ffn
             + 2 * self.dim  # norms
         )
         return embed * 2 + self.n_layers * per_layer + self.dim
@@ -119,12 +135,27 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         "wk": norm_init(keys[1], cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
         "wv": norm_init(keys[2], cfg.dim, (L, cfg.dim, cfg.n_kv_heads * hd)),
         "wo": norm_init(keys[3], cfg.n_heads * hd, (L, cfg.n_heads * hd, cfg.dim)),
-        "w1": norm_init(keys[4], cfg.dim, (L, cfg.dim, cfg.intermediate)),
-        "w3": norm_init(keys[5], cfg.dim, (L, cfg.dim, cfg.intermediate)),
-        "w2": norm_init(keys[6], cfg.intermediate, (L, cfg.intermediate, cfg.dim)),
         "attn_norm": jnp.ones((L, cfg.dim), dt),
         "mlp_norm": jnp.ones((L, cfg.dim), dt),
     }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        layers.update({
+            "router": norm_init(keys[4], cfg.dim, (L, cfg.dim, E)),
+            "w_in": norm_init(
+                keys[5], cfg.dim, (L, E, cfg.dim, cfg.intermediate)
+            ),
+            "w_out": norm_init(
+                keys[6], cfg.intermediate,
+                (L, E, cfg.intermediate, cfg.dim),
+            ),
+        })
+    else:
+        layers.update({
+            "w1": norm_init(keys[4], cfg.dim, (L, cfg.dim, cfg.intermediate)),
+            "w3": norm_init(keys[5], cfg.dim, (L, cfg.dim, cfg.intermediate)),
+            "w2": norm_init(keys[6], cfg.intermediate, (L, cfg.intermediate, cfg.dim)),
+        })
     return {
         "embed": norm_init(k_embed, cfg.dim, (cfg.vocab_size, cfg.dim)),
         "layers": layers,
@@ -137,19 +168,29 @@ def param_annotations(cfg: LlamaConfig) -> Dict[str, Any]:
     """Logical-axis annotations matching init_params' tree: GSPMD maps
     these through PARAM_RULES (fsdp shards embed dims, tp shards
     heads/mlp/vocab)."""
-    return {
-        "embed": annotate("vocab", "embed"),
-        "layers": {
-            "wq": annotate("layers", "embed", "heads"),
-            "wk": annotate("layers", "embed", "kv_heads"),
-            "wv": annotate("layers", "embed", "kv_heads"),
-            "wo": annotate("layers", "heads", "embed"),
+    layers = {
+        "wq": annotate("layers", "embed", "heads"),
+        "wk": annotate("layers", "embed", "kv_heads"),
+        "wv": annotate("layers", "embed", "kv_heads"),
+        "wo": annotate("layers", "heads", "embed"),
+        "attn_norm": annotate("layers", None),
+        "mlp_norm": annotate("layers", None),
+    }
+    if cfg.moe_experts:
+        layers.update({
+            "router": annotate("layers", "embed", None),
+            "w_in": annotate("layers", "expert", "embed", "mlp"),
+            "w_out": annotate("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
             "w1": annotate("layers", "embed", "mlp"),
             "w3": annotate("layers", "embed", "mlp"),
             "w2": annotate("layers", "mlp", "embed"),
-            "attn_norm": annotate("layers", None),
-            "mlp_norm": annotate("layers", None),
-        },
+        })
+    return {
+        "embed": annotate("vocab", "embed"),
+        "layers": layers,
         "final_norm": annotate(None),
         "lm_head": annotate("embed", "vocab"),
     }
@@ -165,8 +206,10 @@ def _attention(cfg: LlamaConfig, q, k, v, sp_axis: Optional[str]):
     return mha_reference(q, k, v, causal=True)
 
 
-def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None):
-    """One decoder block. x: [batch, seq, dim]."""
+def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
+           ep_axis=None):
+    """One decoder block. x: [batch, seq, dim]. Returns (x, aux) where
+    aux is the MoE load-balancing loss (0 for dense layers)."""
     b, t, _ = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"])
@@ -179,19 +222,39 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None):
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
     x = x + attn @ layer["wo"]
     h = rms_norm(x, layer["mlp_norm"])
-    x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
-    return x
+    if cfg.moe_experts:
+        moe_params = {
+            "router": layer["router"],
+            "w_in": layer["w_in"],
+            "w_out": layer["w_out"],
+        }
+        flat = h.reshape(b * t, -1)
+        if ep_axis is not None:
+            out, aux = moe_ffn_ep(
+                moe_params, flat, axis_name=ep_axis,
+                k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+        else:
+            out, aux = moe_ffn_dense(moe_params, flat, k=cfg.moe_top_k)
+        x = x + out.reshape(b, t, -1)
+    else:
+        x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
 
 
-def forward(
+def forward_and_aux(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: LlamaConfig,
     *,
     positions: Optional[jax.Array] = None,
     sp_axis: Optional[str] = None,
-) -> jax.Array:
-    """Token ids [batch, seq] → logits [batch, seq, vocab] (f32).
+    ep_axis: Optional[str] = None,
+) -> tuple:
+    """Token ids [batch, seq] → (logits [batch, seq, vocab] f32,
+    aux: summed MoE load-balancing loss, 0 for dense models).
 
     With sequence parallelism, `tokens` is the local seq shard and
     `positions` carries its global positions.
@@ -203,7 +266,7 @@ def forward(
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(x, layer):
-        return _layer(cfg, x, layer, cos, sin, sp_axis), None
+        return _layer(cfg, x, layer, cos, sin, sp_axis, ep_axis)
 
     if cfg.remat:
         if cfg.remat_policy == "dots":
@@ -214,9 +277,37 @@ def forward(
             )
         else:
             body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, auxs = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.sum(auxs)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    sp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+) -> jax.Array:
+    """Token ids [batch, seq] → logits [batch, seq, vocab] (f32)."""
+    return forward_and_aux(
+        params, tokens, cfg, positions=positions, sp_axis=sp_axis,
+        ep_axis=ep_axis,
+    )[0]
+
+
+def masked_xent(logits: jax.Array, targets: jax.Array) -> tuple:
+    """Masked next-token cross-entropy pieces: (sum_nll, token_count).
+    `targets` < 0 are masked out. Returned unreduced so data-parallel
+    callers can psum both before dividing."""
+    mask = (targets >= 0).astype(jnp.float32)
+    safe_targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask), jnp.sum(mask)
 
 
 def loss_fn(
@@ -227,21 +318,28 @@ def loss_fn(
     *,
     positions: Optional[jax.Array] = None,
     sp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ) -> jax.Array:
-    """Mean next-token cross-entropy. `targets` < 0 are masked out."""
-    logits = forward(
-        params, tokens, cfg, positions=positions, sp_axis=sp_axis
+    """Mean next-token cross-entropy (+ weighted MoE aux loss).
+    `targets` < 0 are masked out."""
+    logits, aux = forward_and_aux(
+        params, tokens, cfg, positions=positions, sp_axis=sp_axis,
+        ep_axis=ep_axis,
     )
-    mask = (targets >= 0).astype(jnp.float32)
-    safe_targets = jnp.maximum(targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    nll_sum, count = masked_xent(logits, targets)
+    xent = nll_sum / jnp.maximum(count, 1.0)
+    return xent + cfg.moe_aux_weight * aux
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Training FLOPs/token (fwd+bwd), standard 6N + attention term —
-    used for MFU accounting in bench.py."""
+    used for MFU accounting in bench.py. For MoE, N counts only the
+    parameters a token activates (top-k experts, not all E)."""
     n = cfg.num_params()
+    if cfg.moe_experts:
+        inactive = (cfg.moe_experts - cfg.moe_top_k) * 2 * (
+            cfg.dim * cfg.intermediate
+        )
+        n -= cfg.n_layers * max(inactive, 0)
     attn = 12 * cfg.n_layers * cfg.dim * seq_len  # causal factor 1/2 applied
     return 6.0 * n + attn / 2

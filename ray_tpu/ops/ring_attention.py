@@ -90,12 +90,16 @@ def ring_attention(
     def _varying(x):
         try:
             return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
+        except (AttributeError, TypeError, ValueError):
+            # Already varying over axis_name (the *_like inits inherit
+            # it from q), or an older jax without pcast.
             return x
 
-    acc = _varying(jnp.zeros((b, h, t_local, d), jnp.float32))
-    m = _varying(jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32))
-    l = _varying(jnp.zeros((b, h, t_local, 1), jnp.float32))
+    # *_like inherits every OTHER varying axis q already carries (pp/ep
+    # when ring attention runs inside the pipeline/MoE composition).
+    acc = _varying(jnp.zeros_like(qf))
+    m = _varying(jnp.full_like(qf[..., :1], -jnp.inf))
+    l = _varying(jnp.zeros_like(qf[..., :1]))
     acc, m, l, _, _ = lax.fori_loop(0, n, step, (acc, m, l, k, v))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (acc / l_safe).astype(q.dtype)

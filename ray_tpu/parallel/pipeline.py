@@ -34,11 +34,15 @@ def spmd_pipeline(
     *,
     axis_name: str = "pp",
     stacked_params: bool = True,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run a stage-partitioned function over microbatches.
 
     stage_fn(stage_params, x) — this rank's stage; all ranks call it
-    every tick (SPMD), invalid ticks are masked.
+    every tick (SPMD), invalid ticks are masked. With `with_aux=True`
+    it must return (y, aux_scalar); aux from valid ticks is summed
+    rank-locally across ticks (aux never travels between stages — sum
+    it over `axis_name` with a psum to get the pipeline total).
     stage_params — a stacked [n_stages, ...] param tree sharded
     P('pp', ...); shard_map hands each rank its [1, ...] slice and the
     singleton stage axis is stripped here (pass stacked_params=False
@@ -46,22 +50,21 @@ def spmd_pipeline(
     microbatches — [num_mb, mb, ...] input, same on every rank (only
     stage 0 actually consumes it).
 
-    Returns [num_mb, mb, ...] outputs, valid on the LAST stage's ranks
-    (other ranks hold zeros); use `broadcast_from_last_stage` if every
-    rank needs them.
+    Returns [num_mb, mb, ...] outputs (or (outputs, aux_sum) with
+    with_aux), valid on the LAST stage's ranks (other ranks hold
+    zeros); use `broadcast_from_last_stage` if every rank needs them.
     """
     if stacked_params:
         stage_params = jax.tree.map(lambda a: a[0], stage_params)
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     num_mb = microbatches.shape[0]
-    mb_shape = microbatches.shape[1:]
     ticks = num_mb + n - 1
     # Stage hop: rank i's output becomes rank i+1's input.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def tick(t, carry):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         # state: activation entering this rank's stage this tick.
         mb_index = t - rank  # microbatch this stage works on
         inject = jnp.take(
@@ -70,9 +73,13 @@ def spmd_pipeline(
             axis=0,
         )
         x = jnp.where(rank == 0, inject, state)
-        y = stage_fn(stage_params, x)
+        if with_aux:
+            y, aux = stage_fn(stage_params, x)
+        else:
+            y, aux = stage_fn(stage_params, x), 0.0
         valid = (mb_index >= 0) & (mb_index < num_mb)
         y = jnp.where(valid, y, jnp.zeros_like(y))
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         # Last stage banks its finished microbatch.
         out_index = jnp.clip(t - (n - 1), 0, num_mb - 1)
         write = valid & (rank == n - 1)
@@ -82,23 +89,30 @@ def spmd_pipeline(
             outputs,
         )
         state = lax.ppermute(y, axis_name, perm)
-        return state, outputs
+        return state, outputs, aux_acc
 
     # The carry is device-varying over pp (each rank holds different
     # activations); mark the zero initializers so scan's type check
-    # agrees (jax >= 0.7 varying-manual-axes).
+    # agrees (jax >= 0.7 varying-manual-axes). zeros_like inherits any
+    # OTHER varying axes (sp/ep) the activations already carry when the
+    # pipeline composes with sequence/expert parallelism.
     state = lax.pcast(
-        jnp.zeros(mb_shape, microbatches.dtype),
+        jnp.zeros_like(jnp.take(microbatches, 0, axis=0)),
         (axis_name,),
         to="varying",
     )
     outputs = lax.pcast(
-        jnp.zeros((num_mb, *mb_shape), microbatches.dtype),
+        jnp.zeros_like(microbatches),
         (axis_name,),
         to="varying",
     )
-    _, outputs = lax.fori_loop(0, ticks, tick, (state, outputs))
-    return outputs
+    aux_acc = lax.pcast(
+        jnp.zeros((), jnp.float32), (axis_name,), to="varying"
+    )
+    _, outputs, aux_acc = lax.fori_loop(
+        0, ticks, tick, (state, outputs, aux_acc)
+    )
+    return (outputs, aux_acc) if with_aux else outputs
 
 
 def broadcast_from_last_stage(
