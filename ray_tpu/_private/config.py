@@ -116,6 +116,14 @@ class Config:
     rpc_retry_base_s: float = 0.1
     rpc_retry_max_s: float = 2.0
 
+    # ---- log streaming (reference: _private/log_monitor.py tails
+    # worker logs and publishes them; the driver prints them with
+    # worker prefixes, worker.py:1966 print_to_stdstream) ----
+    #: Stream worker stdout/stderr lines to connected drivers.
+    log_to_driver: bool = True
+    #: Seconds between log-file tail scans.
+    log_monitor_interval_s: float = 0.2
+
     # ---- task events / observability ----
     #: Ring-buffer length of task state events kept by the control
     #: plane (reference: GcsTaskManager).

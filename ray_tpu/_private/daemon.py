@@ -216,6 +216,14 @@ class NodeDaemon:
         # actor_id -> [(conn, mid)] waiting for the actor's direct
         # address (replied when the actor becomes ALIVE or DEAD).
         self._actor_addr_waiters: Dict[ActorID, list] = {}
+        # Driver connections subscribed to worker log streaming
+        # (reference: log_monitor.py publishes tailed lines; drivers
+        # print them). conn_id -> Connection. On the head this also
+        # holds worker-node relay connections.
+        self._log_subscribers: Dict[int, Connection] = {}
+        # Worker-node cache of "does the head have log subscribers",
+        # piggybacked on heartbeat replies.
+        self._head_logs_wanted = False
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -323,6 +331,9 @@ class NodeDaemon:
             "task_event",
             # object spilling (all nodes)
             "spill_request",
+            # log streaming (subscribe on any node; batch fwd to head)
+            "subscribe_logs",
+            "log_batch",
             # head fault tolerance
             "node_resync",
         ]:
@@ -403,6 +414,11 @@ class NodeDaemon:
                 target=self._spill_loop, daemon=True,
                 name=f"spill:{self.node_id.hex()[:8]}",
             ).start()
+        if self.config.log_to_driver:
+            threading.Thread(
+                target=self._log_monitor_loop, daemon=True,
+                name=f"logs:{self.node_id.hex()[:8]}",
+            ).start()
         if self.config.memory_monitor_refresh_ms > 0:
             from .memory_monitor import MemoryMonitor
 
@@ -414,7 +430,10 @@ class NodeDaemon:
             )
             self._memory_monitor.start()
         if not self.is_head:
-            self.head = RpcClient(self.head_address)
+            self.head = RpcClient(
+                self.head_address, push_handler=self._on_head_push
+            )
+            self.head.set_on_reconnect(self._on_head_reconnect)
             self.head.call(
                 "register_node",
                 node_id=self.node_id.binary(),
@@ -528,7 +547,7 @@ class NodeDaemon:
             self._retry_pending_pgs()
         if any_parked:
             self._retry_infeasible()
-        return {"ok": True}
+        return {"ok": True, "logs_wanted": bool(self._log_subscribers)}
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown:
@@ -541,6 +560,7 @@ class NodeDaemon:
                     queued=self.scheduler.queued_count(),
                     timeout=10.0,
                 )
+                self._head_logs_wanted = bool(reply.get("logs_wanted"))
                 if reply.get("unknown_node"):
                     self._resync_with_head()
             except Exception:
@@ -593,6 +613,11 @@ class NodeDaemon:
             objects=objects,
             timeout=10.0,
         )
+        with self._lock:
+            has_subs = bool(self._log_subscribers)
+        if has_subs:
+            # A restarted head lost our relay subscription.
+            self._ensure_log_relay()
 
     def _h_node_resync(self, conn, msg):
         """A worker node re-reports its live state after a head
@@ -628,6 +653,7 @@ class NodeDaemon:
             winfo = self.workers.pop(conn.conn_id, None)
             self.drivers.pop(conn.conn_id, None)
             dead_node = self._node_conns.pop(conn.conn_id, None)
+            self._log_subscribers.pop(conn.conn_id, None)
         if dead_node is not None:
             self._on_node_death(dead_node)
             return {}
@@ -1209,6 +1235,137 @@ class NodeDaemon:
                 pass
         else:
             self.store.unpin(oid)
+
+    # ------------------------------------------------------------------
+    # log streaming (reference: _private/log_monitor.py — tail worker
+    # log files, publish line batches; driver prints with prefixes)
+    # ------------------------------------------------------------------
+    def _on_head_push(self, channel: str, msg: dict) -> None:
+        """Pushes arriving on the node->head client connection — today
+        only relayed log batches for this node's local drivers."""
+        if channel == "log_lines":
+            self._push_logs(msg.get("batches", []), msg.get("node", ""))
+
+    def _on_head_reconnect(self) -> None:
+        """Per-connection head state must be re-established after a
+        transparent RpcClient reconnect."""
+        with self._lock:
+            has_subs = bool(self._log_subscribers)
+        if has_subs:
+            self._ensure_log_relay()
+
+    def _h_subscribe_logs(self, conn, msg):
+        """Subscribe this connection to streamed worker logs. The conn
+        may be a local driver OR (on the head) a worker-node daemon
+        relaying for its own local drivers."""
+        with self._lock:
+            self._log_subscribers[conn.conn_id] = conn
+        if not self.is_head and self.head is not None:
+            # Relay: all batches flow through the head (every node
+            # forwards there), so a driver attached to a non-head node
+            # sees cluster-wide logs by this node subscribing upstream.
+            self._ensure_log_relay()
+        return {}
+
+    def _ensure_log_relay(self) -> None:
+        try:
+            self.head.notify("subscribe_logs")
+        except Exception:
+            pass
+
+    def _h_log_batch(self, conn, msg):
+        """A worker node forwards its tailed log lines (head only)."""
+        self._push_logs(msg["batches"], msg.get("node", ""))
+        return {}
+
+    def _push_logs(self, batches: list, node: str) -> None:
+        with self._lock:
+            subs = list(self._log_subscribers.items())
+        for conn_id, conn in subs:
+            try:
+                conn.push("log_lines", {"batches": batches, "node": node})
+            except Exception:
+                with self._lock:
+                    self._log_subscribers.pop(conn_id, None)
+
+    def _logs_wanted(self) -> bool:
+        """Whether anyone, anywhere, wants this node's log lines."""
+        if self._log_subscribers:
+            return True
+        # Worker nodes learn via the heartbeat reply whether the head
+        # has subscribers (drivers or node relays).
+        return (not self.is_head) and self._head_logs_wanted
+
+    def _log_monitor_loop(self) -> None:
+        offsets: Dict[str, int] = {}
+        node_hex = self.node_id.hex()[:8]
+        while not self._shutdown:
+            try:
+                if not self._logs_wanted():
+                    # Nobody listening: skip the tail work but keep
+                    # offsets at EOF so a new subscriber gets a live
+                    # stream, not a history dump.
+                    self._fast_forward_logs(offsets)
+                else:
+                    batches = self._tail_worker_logs(offsets)
+                    if batches:
+                        if self.is_head:
+                            self._push_logs(batches, node_hex)
+                        elif self.head is not None:
+                            # Single path: batches go up to the head,
+                            # which fans out to drivers and node
+                            # relays (including back to this node if a
+                            # local driver subscribed) — no double
+                            # delivery.
+                            self.head.notify(
+                                "log_batch", batches=batches,
+                                node=node_hex,
+                            )
+            except Exception:
+                pass
+            time.sleep(self.config.log_monitor_interval_s)
+
+    def _fast_forward_logs(self, offsets: Dict[str, int]) -> None:
+        for i in range(len(self._worker_procs)):
+            path = os.path.join(self.session_dir, f"worker-{i}.out")
+            try:
+                offsets[path] = os.path.getsize(path)
+            except OSError:
+                pass
+
+    def _tail_worker_logs(self, offsets: Dict[str, int]) -> list:
+        """Read complete new lines from each worker's log file."""
+        batches = []
+        for i, proc in enumerate(list(self._worker_procs)):
+            path = os.path.join(self.session_dir, f"worker-{i}.out")
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = offsets.get(path, 0)
+            if size <= off:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    data = f.read(min(size - off, 256 * 1024))
+            except OSError:
+                continue
+            nl = data.rfind(b"\n")
+            if nl < 0:
+                # No complete line yet; flush anyway if the partial
+                # line is absurdly long so progress can't stall.
+                if len(data) < 64 * 1024:
+                    continue
+                nl = len(data) - 1
+            chunk, consumed = data[: nl + 1], nl + 1
+            offsets[path] = off + consumed
+            batches.append({
+                "worker": i,
+                "pid": proc.pid,
+                "lines": chunk.decode(errors="replace").splitlines(),
+            })
+        return batches
 
     def _spill_loop(self) -> None:
         while not self._shutdown:

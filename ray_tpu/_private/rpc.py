@@ -438,11 +438,17 @@ class RpcClient:
         push_handler: Optional[Callable[[str, dict], None]] = None,
         connect_timeout: float = 10.0,
         auth_key: Optional[bytes] = None,
+        on_reconnect: Optional[Callable[[], None]] = None,
     ):
         self._path = path
         self._parsed = parse_address(path)
         self.auth_key = auth_key or default_auth_key()
         self._push_handler = push_handler
+        #: Called (on the reconnecting thread, outside locks) after a
+        #: successful reconnect — the server saw a brand-new connection,
+        #: so per-connection server state (e.g. log subscriptions) must
+        #: be re-established by the client.
+        self._on_reconnect = on_reconnect
         self._mid = 0
         self._lock = threading.Lock()
         # Serializes whole frames: call()/notify() run on arbitrary
@@ -473,6 +479,9 @@ class RpcClient:
         #: _connect (mirrors Connection.serve). Replaced on _reconnect.
         self._sock, self._conn_key = sock, key
         self._start_reader(sock, key, self._conn_gen)
+
+    def set_on_reconnect(self, cb: Optional[Callable[[], None]]) -> None:
+        self._on_reconnect = cb
 
     def _start_reader(self, sock, key, gen) -> None:
         self._reader = threading.Thread(
@@ -742,6 +751,11 @@ class RpcClient:
                 except Exception:
                     pass
             self._start_reader(sock, key, gen)
+            if self._on_reconnect is not None:
+                try:
+                    self._on_reconnect()
+                except Exception:
+                    pass
 
     def close(self) -> None:
         self._closed = True

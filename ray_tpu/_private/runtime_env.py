@@ -10,9 +10,15 @@ and prepend to sys.path. env_vars apply around task execution and are
 restored afterwards (shared workers); actors keep their env for life
 (they pin their worker).
 
-`pip`/`conda`/`uv` fields raise RuntimeEnvSetupError: the deployment
-environment is hermetic (no package installs at runtime); images are
-the supported isolation unit.
+`pip` creates a node-local virtualenv per requirements hash (reference:
+runtime_env/pip.py builds a virtualenv + pip-installs into it, cached
+by a hash of the spec) and prepends its site-packages around task
+execution; restore also evicts the env's modules from sys.modules so
+shared workers stay clean. The hermetic deployment has no package
+index, so requirements must resolve offline (local wheels/dirs) —
+network installs surface as RuntimeEnvSetupError exactly like a failed
+pip would. `conda`/`uv` raise RuntimeEnvSetupError: not installed in
+the image; `pip` is the supported installer.
 """
 
 from __future__ import annotations
@@ -75,14 +81,16 @@ def prepare_runtime_env(
     unknown = set(env) - _KNOWN_FIELDS - set(PLUGINS)
     if unknown:
         raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
-    for banned in ("pip", "conda", "uv"):
+    for banned in ("conda", "uv"):
         if env.get(banned):
             raise exc.RuntimeEnvSetupError(
-                f"runtime_env[{banned!r}] is unsupported: runtime "
-                "package installation is disabled in this environment; "
-                "bake dependencies into the image instead"
+                f"runtime_env[{banned!r}] is unsupported: {banned} is "
+                "not installed in this image; use runtime_env['pip'] "
+                "or bake dependencies into the image"
             )
     wire: Dict[str, Any] = {}
+    if env.get("pip"):
+        wire["pip"] = _normalize_pip(env["pip"])
     if env.get("env_vars"):
         wire["env_vars"] = {
             str(k): str(v) for k, v in env["env_vars"].items()
@@ -155,6 +163,115 @@ def _upload_dir(path: str, worker, nest_under_name: bool = False) -> dict:
     return wire
 
 
+def _normalize_pip(spec) -> dict:
+    """Driver-side pip spec -> wire form {packages, hash} (reference:
+    pip.py accepts a list or {'packages': [...]}; the cache key is a
+    hash of the normalized spec)."""
+    if isinstance(spec, dict):
+        packages = list(spec.get("packages") or [])
+    elif isinstance(spec, (list, tuple)):
+        packages = list(spec)
+    else:
+        raise exc.RuntimeEnvSetupError(
+            f"runtime_env['pip'] must be a list of requirements or "
+            f"{{'packages': [...]}}, got {type(spec).__name__}"
+        )
+    if not all(isinstance(p, str) for p in packages):
+        raise exc.RuntimeEnvSetupError(
+            "runtime_env['pip'] entries must be strings"
+        )
+    # Local paths resolve to absolute so workers on this node agree;
+    # hashing covers content signatures so a rebuilt wheel or an edited
+    # source dir busts the cache. Path detection follows pip's syntax
+    # (./foo, /abs, archive suffixes) — a bare requirement name that
+    # happens to collide with a cwd entry stays a requirement.
+    norm = []
+    sig = []
+    for p in packages:
+        if _looks_like_path(p) and os.path.exists(p):
+            real = os.path.realpath(p)
+            norm.append(real)
+            if os.path.isdir(real):
+                sig.append(f"{real}:{_dir_signature(real)}")
+            else:
+                try:
+                    st = os.stat(real)
+                    sig.append(f"{real}:{st.st_size}:{st.st_mtime_ns}")
+                except OSError:
+                    sig.append(real)
+        else:
+            norm.append(p)
+            sig.append(p)
+    digest = hashlib.sha256(
+        "\n".join(sorted(sig)).encode()
+    ).hexdigest()[:16]
+    return {"packages": norm, "hash": digest}
+
+
+_ARCHIVE_SUFFIXES = (".whl", ".tar.gz", ".zip", ".tar.bz2")
+
+
+def _looks_like_path(req: str) -> bool:
+    """pip's convention: only explicit path forms are paths."""
+    return (
+        req.startswith(("/", "./", "../", "~"))
+        or req.endswith(_ARCHIVE_SUFFIXES)
+        or os.sep in req
+    )
+
+
+def _ensure_pip_env(pip_wire: dict) -> str:
+    """Worker-side: build (once per requirements hash per node) an
+    isolated package dir via host `pip install --target` and return it
+    for sys.path prepending. A full virtualenv would add interpreter
+    symlinks nothing executes — the path prepend IS the isolation unit
+    here (the reference swaps worker interpreters instead,
+    runtime_env/pip.py). Concurrency-safe via build-in-tmp-then-rename."""
+    import subprocess
+
+    target = os.path.join(_CACHE_ROOT, "pip-" + pip_wire["hash"])
+    if os.path.isdir(target):
+        return target
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    tmp = target + f".tmp{os.getpid()}"
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "pip", "install",
+                    "--quiet", "--disable-pip-version-check",
+                    "--no-input", "--target", tmp,
+                    *pip_wire["packages"],
+                ],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+        except subprocess.TimeoutExpired as e:
+            raise exc.RuntimeEnvSetupError(
+                f"pip install timed out after 600s for runtime_env"
+                f"{pip_wire['packages']}"
+            ) from e
+        if proc.returncode != 0:
+            raise exc.RuntimeEnvSetupError(
+                "pip install failed for runtime_env"
+                f"{pip_wire['packages']}:\n{proc.stderr[-2000:]}"
+            )
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+    finally:
+        if os.path.isdir(tmp):
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
 def _fetch_package(pkg: dict, worker) -> str:
     """Worker-side: download + extract once per content hash."""
     target = os.path.join(_CACHE_ROOT, pkg["hash"])
@@ -189,10 +306,24 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
     saved_env: Dict[str, Optional[str]] = {}
     saved_path = list(sys.path)
     saved_cwd = os.getcwd()
+    pip_site: Optional[str] = None
     try:
         for key, value in (wire.get("env_vars") or {}).items():
             saved_env[key] = os.environ.get(key)
             os.environ[key] = value
+        if wire.get("pip"):
+            import importlib
+
+            pip_site = _ensure_pip_env(wire["pip"])
+            sys.path.insert(0, pip_site)
+            # Subprocesses the task spawns inherit the env too.
+            saved_env.setdefault(
+                "PYTHONPATH", os.environ.get("PYTHONPATH")
+            )
+            os.environ["PYTHONPATH"] = os.pathsep.join(
+                p for p in (pip_site, os.environ.get("PYTHONPATH")) if p
+            )
+            importlib.invalidate_caches()
         if wire.get("working_dir"):
             workdir = _fetch_package(wire["working_dir"], worker)
             os.chdir(workdir)
@@ -215,3 +346,16 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
                 os.chdir(saved_cwd)
             except OSError:
                 pass
+            if pip_site is not None:
+                # Evict the env's modules so a later task on this
+                # shared worker can't import them via sys.modules
+                # (the reference avoids this by dedicating workers per
+                # env; we restore instead). Namespace packages have
+                # __file__=None but carry the env dir in __path__.
+                for name, mod in list(sys.modules.items()):
+                    file = getattr(mod, "__file__", None) or ""
+                    paths = list(getattr(mod, "__path__", None) or [])
+                    if file.startswith(pip_site) or any(
+                        str(p).startswith(pip_site) for p in paths
+                    ):
+                        del sys.modules[name]

@@ -20,6 +20,7 @@ import inspect
 import itertools
 import os
 import queue
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -176,6 +177,15 @@ class CoreWorker:
             from .direct import DirectTaskManager
 
             self._direct = DirectTaskManager(self)
+        if role == "driver" and self.config.log_to_driver:
+            # Worker stdout/stderr streams to this process (reference:
+            # log_monitor.py subscription on driver startup). The
+            # subscription is per-connection daemon state, so it must
+            # be re-sent after any transparent RPC reconnect.
+            self._client.notify("subscribe_logs")
+            self._client.set_on_reconnect(
+                lambda: self._client.notify("subscribe_logs")
+            )
 
     def _notify_store_evict(self, oid: ObjectID) -> None:
         """Arena evictions can originate in any process; tell the node
@@ -800,9 +810,23 @@ class CoreWorker:
     def _on_push(self, channel: str, msg: dict) -> None:
         if channel == "execute_task":
             self._task_queue.put((msg["spec"], None))
+        elif channel == "log_lines":
+            self._print_worker_logs(msg)
         elif channel == "exit":
             self._running = False
             self._task_queue.put(None)
+
+    def _print_worker_logs(self, msg: dict) -> None:
+        """Print streamed worker output with source prefixes
+        (reference: worker.py:1966 print_to_stdstream with the
+        '(pid=…, ip=…)' prefix convention)."""
+        node = msg.get("node", "")
+        for batch in msg.get("batches", []):
+            prefix = f"(worker-{batch['worker']} pid={batch['pid']}" + (
+                f" node={node})" if node else ")"
+            )
+            for line in batch["lines"]:
+                print(f"{prefix} {line}", file=sys.stderr)
 
     def current_pg_context(self) -> Optional[dict]:
         """Capturing-placement-group context of the task this thread is

@@ -1,0 +1,138 @@
+"""Worker log streaming to the driver (reference behavior:
+python/ray/_private/log_monitor.py tails worker logs and publishes
+them; the driver prints them prefixed, worker.py:1966)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+@pytest.fixture
+def cluster():
+    rt.init(
+        num_cpus=2,
+        _system_config={"log_monitor_interval_s": 0.05},
+    )
+    yield
+    rt.shutdown()
+
+
+def _wait_for(capfd, needle, timeout=15):
+    deadline = time.time() + timeout
+    seen = ""
+    while time.time() < deadline:
+        out, err = capfd.readouterr()
+        seen += out + err
+        if needle in seen:
+            return seen
+        time.sleep(0.1)
+    raise AssertionError(f"{needle!r} never streamed; got: {seen[-2000:]}")
+
+
+def test_remote_print_reaches_driver(cluster, capfd):
+    @rt.remote
+    def shout():
+        print("hello-from-worker-4242")
+        return 1
+
+    assert rt.get(shout.remote()) == 1
+    seen = _wait_for(capfd, "hello-from-worker-4242")
+    # Prefixed with the source worker identity.
+    line = next(
+        l for l in seen.splitlines() if "hello-from-worker-4242" in l
+    )
+    assert "pid=" in line and "worker-" in line
+
+
+def test_actor_stderr_reaches_driver(cluster, capfd):
+    @rt.remote
+    class Noisy:
+        def speak(self):
+            print("actor-stderr-7777", file=sys.stderr)
+            return "ok"
+
+    a = Noisy.remote()
+    assert rt.get(a.speak.remote()) == "ok"
+    _wait_for(capfd, "actor-stderr-7777")
+
+
+def test_remote_node_print_reaches_driver(capfd):
+    """Lines tailed on a WORKER node forward through the head to the
+    driver (reference: log_monitor runs per node, publishes centrally)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 1.0})
+    rt.init(address=c.address)
+    try:
+        c.add_node(num_cpus=2, resources={"special": 2.0})
+        c.wait_for_nodes(2)
+
+        @rt.remote(resources={"special": 1.0})
+        def shout():
+            print("hello-from-remote-node-9191")
+            return 1
+
+        assert rt.get(shout.remote(), timeout=30) == 1
+        _wait_for(capfd, "hello-from-remote-node-9191")
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_logs_wanted_gating_via_heartbeat():
+    """Worker nodes only pay the tail-and-forward cost while the head
+    actually has subscribers; the bit rides the heartbeat reply.
+    (Drivers can only attach to the head in this architecture, so the
+    subscriber set lives there.)"""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    node = c.add_node(num_cpus=1)
+    try:
+        c.wait_for_nodes(2)
+        # No driver yet: after a couple heartbeats the node must see
+        # logs_wanted == False.
+        time.sleep(1.0)
+        assert node._head_logs_wanted is False
+        rt.init(address=c.address)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not node._head_logs_wanted:
+                time.sleep(0.1)
+            assert node._head_logs_wanted is True
+            assert c.head._log_subscribers
+        finally:
+            rt.shutdown()
+        deadline = time.time() + 10
+        while time.time() < deadline and node._head_logs_wanted:
+            time.sleep(0.1)
+        assert node._head_logs_wanted is False
+    finally:
+        c.shutdown()
+
+
+def test_log_to_driver_off_is_quiet():
+    rt.init(
+        num_cpus=1,
+        _system_config={"log_to_driver": False},
+    )
+    try:
+
+        @rt.remote
+        def quiet():
+            print("should-not-stream-1111")
+            return 1
+
+        assert rt.get(quiet.remote()) == 1
+        time.sleep(1.0)
+        worker = __import__(
+            "ray_tpu._private.worker", fromlist=["global_worker"]
+        ).global_worker()
+        # The daemon-side monitor never started; nothing subscribed.
+        daemon = rt.api._session.daemon
+        assert not daemon._log_subscribers
+    finally:
+        rt.shutdown()

@@ -99,11 +99,13 @@ def test_actor_keeps_runtime_env(rt_session):
     assert rt.get(holder.read.remote(), timeout=30) == "sticky"
 
 
-def test_pip_rejected(rt_session):
+def test_conda_rejected(rt_session):
+    """pip is now supported (tests/test_runtime_env_pip.py); conda/uv
+    stay rejected — not installed in the image."""
     rt = rt_session
     import ray_tpu.exceptions as exc
 
-    @rt.remote(runtime_env={"pip": ["requests"]})
+    @rt.remote(runtime_env={"conda": ["something"]})
     def nope():
         return 1
 

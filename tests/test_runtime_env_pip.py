@@ -1,0 +1,114 @@
+"""pip runtime environments: per-requirements-hash venv creation,
+offline local-wheel installs, and no pollution of the shared session
+env (reference behavior: python/ray/_private/runtime_env/pip.py —
+virtualenv per spec hash, cached)."""
+
+import os
+import zipfile
+
+import pytest
+
+import ray_tpu as rt
+
+WHEEL_NAME = "testpkg_rt-0.1-py3-none-any.whl"
+
+
+def _forge_wheel(tmp_path, value=42):
+    """Hand-build a tiny pure-python wheel (a wheel is just a zip with
+    dist-info) so the test installs fully offline."""
+    dist = "testpkg_rt-0.1.dist-info"
+    path = tmp_path / WHEEL_NAME
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("testpkg_rt.py", f"VALUE = {value}\n")
+        zf.writestr(
+            f"{dist}/METADATA",
+            "Metadata-Version: 2.1\nName: testpkg-rt\nVersion: 0.1\n",
+        )
+        zf.writestr(
+            f"{dist}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: forge\nRoot-Is-Purelib: "
+            "true\nTag: py3-none-any\n",
+        )
+        zf.writestr(
+            f"{dist}/RECORD",
+            f"testpkg_rt.py,,\n{dist}/METADATA,,\n{dist}/WHEEL,,\n"
+            f"{dist}/RECORD,,\n",
+        )
+    return str(path)
+
+
+@pytest.fixture
+def single_worker():
+    # One CPU => one shared worker: the no-env task below provably runs
+    # on the SAME process the pip task used.
+    rt.init(num_cpus=1)
+    yield
+    rt.shutdown()
+
+
+def test_wheel_installs_and_does_not_pollute(single_worker, tmp_path):
+    wheel = _forge_wheel(tmp_path)
+
+    @rt.remote(runtime_env={"pip": [wheel]})
+    def use():
+        import testpkg_rt
+
+        return testpkg_rt.VALUE, testpkg_rt.__file__
+
+    @rt.remote
+    def probe():
+        try:
+            import testpkg_rt  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    value, file = rt.get(use.remote(), timeout=180)
+    assert value == 42
+    assert "pip-" in file, f"must import from the venv, got {file}"
+    # Same worker, no runtime env: the module must NOT be reachable —
+    # neither via sys.path nor via a stale sys.modules entry.
+    assert rt.get(probe.remote(), timeout=60) == "clean"
+    # And the session interpreter (driver) is untouched.
+    with pytest.raises(ImportError):
+        import testpkg_rt  # noqa: F401
+
+
+def test_venv_cached_by_requirements_hash(single_worker, tmp_path):
+    wheel = _forge_wheel(tmp_path)
+
+    @rt.remote(runtime_env={"pip": [wheel]})
+    def use():
+        import testpkg_rt
+
+        return os.path.dirname(os.path.dirname(testpkg_rt.__file__))
+
+    site1 = rt.get(use.remote(), timeout=180)
+    marker = os.path.join(site1, "cache-marker")
+    open(marker, "w").close()
+    # Second task, same requirements: reuses the cached venv (marker
+    # survives => no rebuild).
+    site2 = rt.get(use.remote(), timeout=60)
+    assert site2 == site1
+    assert os.path.exists(marker)
+
+
+def test_conda_uv_still_rejected(single_worker):
+    @rt.remote(runtime_env={"conda": {"deps": ["x"]}})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="conda"):
+        rt.get(f.remote(), timeout=30)
+
+
+def test_bad_requirement_surfaces_setup_error(single_worker):
+    @rt.remote(
+        runtime_env={"pip": ["/nonexistent/definitely_missing.whl"]}
+    )
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="pip install failed"):
+        rt.get(f.remote(), timeout=120)
