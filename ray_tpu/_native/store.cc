@@ -423,6 +423,14 @@ int64_t rts_create(void* handle, const uint8_t* oid, uint64_t size,
   slot->offset = static_cast<uint64_t>(offset);
   slot->size = size;
   slot->lru_tick = ++h->header->lru_clock;
+#ifdef MADV_POPULATE_WRITE
+  // Pre-fault the extent so the producer's memcpy streams into mapped
+  // pages instead of paying a page fault per 4K (plasma pre-touches
+  // its arena the same way). First writes to fresh /dev/shm pages
+  // otherwise dominate large-object put latency. Best-effort: EINVAL
+  // on old kernels is fine.
+  madvise(h->heap + offset, need, MADV_POPULATE_WRITE);
+#endif
   return offset;
 }
 

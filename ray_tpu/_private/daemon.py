@@ -333,6 +333,7 @@ class NodeDaemon:
             "spill_request",
             # log streaming (subscribe on any node; batch fwd to head)
             "subscribe_logs",
+            "unsubscribe_logs",
             "log_batch",
             # head fault tolerance
             "node_resync",
@@ -653,7 +654,7 @@ class NodeDaemon:
             winfo = self.workers.pop(conn.conn_id, None)
             self.drivers.pop(conn.conn_id, None)
             dead_node = self._node_conns.pop(conn.conn_id, None)
-            self._log_subscribers.pop(conn.conn_id, None)
+        self._drop_log_subscriber(conn.conn_id)
         if dead_node is not None:
             self._on_node_death(dead_node)
             return {}
@@ -1273,12 +1274,41 @@ class NodeDaemon:
         except Exception:
             pass
 
+    def _h_unsubscribe_logs(self, conn, msg):
+        self._drop_log_subscriber(conn.conn_id)
+        return {}
+
+    def _drop_log_subscriber(self, conn_id: int) -> None:
+        """Remove one subscriber; when a relay node's LAST local
+        subscriber goes, tear the upstream relay down too — otherwise
+        one past driver session would keep the whole cluster tailing
+        and forwarding forever."""
+        with self._lock:
+            was_sub = self._log_subscribers.pop(conn_id, None) is not None
+            any_left = bool(self._log_subscribers)
+        if (
+            was_sub
+            and not any_left
+            and not self.is_head
+            and self.head is not None
+        ):
+            try:
+                self.head.notify("unsubscribe_logs")
+            except Exception:
+                pass
+
     def _h_log_batch(self, conn, msg):
         """A worker node forwards its tailed log lines (head only)."""
         self._push_logs(msg["batches"], msg.get("node", ""))
         return {}
 
     def _push_logs(self, batches: list, node: str) -> None:
+        # Known limitation vs the reference's per-job log_monitor
+        # filtering: workers here are shared across jobs, so a stdout
+        # line has no reliable job attribution — every subscriber gets
+        # every line (prefixed by worker/pid/node). Multi-driver
+        # sessions wanting isolation set log_to_driver=False and read
+        # session-dir files.
         with self._lock:
             subs = list(self._log_subscribers.items())
         for conn_id, conn in subs:

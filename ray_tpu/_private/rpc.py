@@ -698,6 +698,7 @@ class RpcClient:
         caller observed failing; if another thread already reconnected
         past it, this is a no-op (two racing retries produce one new
         connection, not two)."""
+        reconnected = False
         with self._reconnect_lock:
             with self._lock:
                 if self._closed:
@@ -751,11 +752,15 @@ class RpcClient:
                 except Exception:
                     pass
             self._start_reader(sock, key, gen)
-            if self._on_reconnect is not None:
-                try:
-                    self._on_reconnect()
-                except Exception:
-                    pass
+            reconnected = True
+        # Outside _reconnect_lock: a callback that triggers another
+        # reconnect (its call() hits a dying fresh connection) must not
+        # self-deadlock on the non-reentrant lock.
+        if reconnected and self._on_reconnect is not None:
+            try:
+                self._on_reconnect()
+            except Exception:
+                pass
 
     def close(self) -> None:
         self._closed = True

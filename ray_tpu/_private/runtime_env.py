@@ -90,7 +90,7 @@ def prepare_runtime_env(
             )
     wire: Dict[str, Any] = {}
     if env.get("pip"):
-        wire["pip"] = _normalize_pip(env["pip"])
+        wire["pip"] = _normalize_pip(env["pip"], worker)
     if env.get("env_vars"):
         wire["env_vars"] = {
             str(k): str(v) for k, v in env["env_vars"].items()
@@ -163,10 +163,12 @@ def _upload_dir(path: str, worker, nest_under_name: bool = False) -> dict:
     return wire
 
 
-def _normalize_pip(spec) -> dict:
+def _normalize_pip(spec, worker) -> dict:
     """Driver-side pip spec -> wire form {packages, hash} (reference:
     pip.py accepts a list or {'packages': [...]}; the cache key is a
-    hash of the normalized spec)."""
+    hash of the normalized spec). Local wheels/dirs upload to the
+    cluster KV — workers on other nodes have no shared filesystem, so
+    paths must ship as content, the same way working_dir does."""
     if isinstance(spec, dict):
         packages = list(spec.get("packages") or [])
     elif isinstance(spec, (list, tuple)):
@@ -180,25 +182,23 @@ def _normalize_pip(spec) -> dict:
         raise exc.RuntimeEnvSetupError(
             "runtime_env['pip'] entries must be strings"
         )
-    # Local paths resolve to absolute so workers on this node agree;
-    # hashing covers content signatures so a rebuilt wheel or an edited
-    # source dir busts the cache. Path detection follows pip's syntax
-    # (./foo, /abs, archive suffixes) — a bare requirement name that
-    # happens to collide with a cwd entry stays a requirement.
-    norm = []
+    # Path detection follows pip's syntax (./foo, /abs, ~/x, archive
+    # suffixes) — a bare requirement name that happens to collide with
+    # a cwd entry stays a requirement. Hashing is content-addressed,
+    # so a rebuilt wheel or edited source dir busts the env cache.
+    norm: list = []
     sig = []
     for p in packages:
-        if _looks_like_path(p) and os.path.exists(p):
-            real = os.path.realpath(p)
-            norm.append(real)
+        px = os.path.expanduser(p)
+        if _looks_like_path(p) and os.path.exists(px):
+            real = os.path.realpath(px)
             if os.path.isdir(real):
-                sig.append(f"{real}:{_dir_signature(real)}")
+                entry = {"dir": _upload_dir(real, worker)}
+                sig.append("dir:" + entry["dir"]["hash"])
             else:
-                try:
-                    st = os.stat(real)
-                    sig.append(f"{real}:{st.st_size}:{st.st_mtime_ns}")
-                except OSError:
-                    sig.append(real)
+                entry = {"file": _upload_file(real, worker)}
+                sig.append("file:" + entry["file"]["hash"])
+            norm.append(entry)
         else:
             norm.append(p)
             sig.append(p)
@@ -206,6 +206,42 @@ def _normalize_pip(spec) -> dict:
         "\n".join(sorted(sig)).encode()
     ).hexdigest()[:16]
     return {"packages": norm, "hash": digest}
+
+
+def _upload_file(path: str, worker) -> dict:
+    """Content-address one local file (wheel/archive) into the KV."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise exc.RuntimeEnvSetupError(
+            f"pip requirement {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES})"
+        )
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    key = f"__rt_pkg__{digest}"
+    if key not in worker.call("kv_keys", prefix=key).get("keys", []):
+        worker.call("kv_put", key=key, value=data)
+    return {"key": key, "hash": digest, "name": os.path.basename(path)}
+
+
+def _fetch_file(entry: dict, worker) -> str:
+    """Worker-side: materialize an uploaded file requirement, keeping
+    its original basename (pip parses wheel names)."""
+    dirpath = os.path.join(_CACHE_ROOT, "files", entry["hash"])
+    path = os.path.join(dirpath, entry["name"])
+    if os.path.exists(path):
+        return path
+    reply = worker.call("kv_get", key=entry["key"])
+    if reply.get("value") is None:
+        raise exc.RuntimeEnvSetupError(
+            f"pip package {entry['key']} missing from cluster KV"
+        )
+    os.makedirs(dirpath, exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(reply["value"])
+    os.replace(tmp, path)
+    return path
 
 
 _ARCHIVE_SUFFIXES = (".whl", ".tar.gz", ".zip", ".tar.bz2")
@@ -220,7 +256,7 @@ def _looks_like_path(req: str) -> bool:
     )
 
 
-def _ensure_pip_env(pip_wire: dict) -> str:
+def _ensure_pip_env(pip_wire: dict, worker) -> str:
     """Worker-side: build (once per requirements hash per node) an
     isolated package dir via host `pip install --target` and return it
     for sys.path prepending. A full virtualenv would add interpreter
@@ -232,6 +268,16 @@ def _ensure_pip_env(pip_wire: dict) -> str:
     target = os.path.join(_CACHE_ROOT, "pip-" + pip_wire["hash"])
     if os.path.isdir(target):
         return target
+    # Materialize uploaded local requirements (wheels/source dirs)
+    # from the cluster KV onto this node first.
+    reqs = []
+    for entry in pip_wire["packages"]:
+        if isinstance(entry, str):
+            reqs.append(entry)
+        elif "file" in entry:
+            reqs.append(_fetch_file(entry["file"], worker))
+        else:
+            reqs.append(_fetch_package(entry["dir"], worker))
     os.makedirs(_CACHE_ROOT, exist_ok=True)
     tmp = target + f".tmp{os.getpid()}"
     try:
@@ -242,7 +288,7 @@ def _ensure_pip_env(pip_wire: dict) -> str:
                     sys.executable, "-m", "pip", "install",
                     "--quiet", "--disable-pip-version-check",
                     "--no-input", "--target", tmp,
-                    *pip_wire["packages"],
+                    *reqs,
                 ],
                 capture_output=True,
                 text=True,
@@ -314,7 +360,7 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
         if wire.get("pip"):
             import importlib
 
-            pip_site = _ensure_pip_env(wire["pip"])
+            pip_site = _ensure_pip_env(wire["pip"], worker)
             sys.path.insert(0, pip_site)
             # Subprocesses the task spawns inherit the env too.
             saved_env.setdefault(

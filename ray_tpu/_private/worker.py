@@ -370,22 +370,36 @@ class CoreWorker:
                     )
                     return self._read_local_store(oid, payload, remaining)
                 # fell back to the daemon path: ask it below
-        try:
-            reply = self._client.call(
-                "get_object", oid=oid.binary(), timeout=timeout
-            )
-        except RpcError as e:
-            if "__timeout__" in str(e):
-                raise exc.GetTimeoutError(
-                    f"get() timed out waiting for {oid}"
-                ) from None
-            raise
-        if "error" in reply and reply["error"] is not None:
-            raise_from_payload(reply["error"])
-        if reply.get("inline") is not None:
-            return self.serialization.deserialize(reply["inline"])
-        remaining = None if deadline is None else deadline - time.time()
-        return self._read_local_store(oid, reply["shm_size"], remaining)
+        while True:
+            timeout = None if deadline is None else deadline - time.time()
+            try:
+                reply = self._client.call(
+                    "get_object", oid=oid.binary(), timeout=timeout
+                )
+            except RpcError as e:
+                if "__timeout__" in str(e):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid}"
+                    ) from None
+                raise
+            if "error" in reply and reply["error"] is not None:
+                raise_from_payload(reply["error"])
+            if reply.get("inline") is not None:
+                return self.serialization.deserialize(reply["inline"])
+            remaining = None if deadline is None else deadline - time.time()
+            try:
+                return self._read_local_store(
+                    oid, reply["shm_size"], remaining
+                )
+            except FileNotFoundError:
+                # The daemon spilled/evicted the segment between its
+                # reply and our attach; re-ask — the daemon's get path
+                # restores from spill (or re-pulls/reconstructs).
+                if deadline is not None and deadline - time.time() <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid}"
+                    ) from None
+                time.sleep(0.01)
 
     def peek_object_error(self, oid: ObjectID) -> Optional[bytes]:
         """Error payload of a KNOWN-READY object, or None if it holds a

@@ -94,6 +94,33 @@ def test_venv_cached_by_requirements_hash(single_worker, tmp_path):
     assert os.path.exists(marker)
 
 
+def test_wheel_installs_on_remote_node(tmp_path):
+    """Local wheel requirements ship through the cluster KV — workers
+    on OTHER nodes (no shared filesystem with the driver) install from
+    the fetched content, like working_dir does."""
+    from ray_tpu.cluster_utils import Cluster
+
+    wheel = _forge_wheel(tmp_path)
+    c = Cluster(initialize_head=True, head_resources={"CPU": 1.0})
+    rt.init(address=c.address)
+    try:
+        c.add_node(num_cpus=1, resources={"special": 1.0})
+        c.wait_for_nodes(2)
+
+        @rt.remote(
+            resources={"special": 1.0}, runtime_env={"pip": [wheel]}
+        )
+        def use():
+            import testpkg_rt
+
+            return testpkg_rt.VALUE
+
+        assert rt.get(use.remote(), timeout=180) == 42
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+
 def test_conda_uv_still_rejected(single_worker):
     @rt.remote(runtime_env={"conda": {"deps": ["x"]}})
     def f():
