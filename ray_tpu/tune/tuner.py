@@ -136,6 +136,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Optional[TrialScheduler] = None
+    #: Adaptive searcher (e.g. search.TPESearcher). None = grid/random
+    #: via BasicVariantGenerator. Composes with any scheduler (TPE +
+    #: ASHA = the BOHB recipe).
+    search_alg: Optional[Any] = None
     resources_per_trial: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
 
@@ -210,16 +214,30 @@ class Tuner:
         return path
 
     @staticmethod
-    def restore(path: str, trainable) -> "Tuner":
+    def restore(
+        path: str,
+        trainable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        search_alg=None,
+        scheduler=None,
+    ) -> "Tuner":
         """Resume an interrupted experiment: finished trials keep their
         results; unfinished ones run again from their last checkpoint
-        (reference: Tuner.restore + experiment_state.py)."""
+        (reference: Tuner.restore + experiment_state.py). Live objects
+        (search_alg, scheduler) and the param_space are not serialized
+        — re-pass them here to resume an adaptive search: fit() replays
+        every finished trial into the searcher before suggesting the
+        remaining num_samples."""
         with open(os.path.join(path, "experiment_state.json")) as f:
             state = json.load(f)
         tuner = Tuner(
             trainable,
+            param_space=param_space,
             tune_config=TuneConfig(**state["tune_config"]),
         )
+        tuner._tune_config.search_alg = search_alg
+        tuner._tune_config.scheduler = scheduler
         tuner._storage_override = path  # type: ignore[attr-defined]
         for snap in state["trials"]:
             tuner._trials.append(Trial(**snap))
@@ -228,6 +246,7 @@ class Tuner:
     def _save_state(self, path: str) -> None:
         cfg = dataclasses.asdict(self._tune_config)
         cfg.pop("scheduler", None)
+        cfg.pop("search_alg", None)  # live object; re-passed on restore
         cfg.pop("resources_per_trial", None)
         state = {
             "tune_config": cfg,
@@ -247,7 +266,19 @@ class Tuner:
             self._storage_dir()
         )
         scheduler = cfg.scheduler or FIFOScheduler()
-        if not self._trials:
+        searcher = cfg.search_alg
+        if searcher is not None:
+            searcher.setup(
+                self._param_space, cfg.metric, cfg.mode, cfg.seed
+            )
+            # Resumed experiments replay finished trials into the
+            # searcher so its model starts where the run left off.
+            for t in self._trials:
+                if t.state in (TERMINATED, ERROR):
+                    searcher.record(
+                        t.config, t.last_result, error=(t.state == ERROR)
+                    )
+        elif not self._trials:
             generator = BasicVariantGenerator(cfg.seed)
             for config in generator.generate(
                 self._param_space, cfg.num_samples
@@ -273,10 +304,38 @@ class Tuner:
 
         pending = [t for t in self._trials if t.state == PENDING]
         running: List[Trial] = []
+        suggested = len(self._trials)
+
+        def next_trial() -> Optional[Trial]:
+            nonlocal suggested
+            if pending:
+                return pending.pop(0)
+            if searcher is not None and suggested < cfg.num_samples:
+                suggested += 1
+                trial = Trial(
+                    trial_id=uuid.uuid4().hex[:10],
+                    config=searcher.suggest(),
+                )
+                self._trials.append(trial)
+                return trial
+            return None
+
+        def trial_finished(trial: Trial) -> None:
+            if searcher is not None:
+                searcher.record(
+                    trial.config, trial.last_result,
+                    error=(trial.state == ERROR),
+                )
+
         try:
-            while pending or running:
-                while pending and len(running) < cfg.max_concurrent_trials:
-                    trial = pending.pop(0)
+            while (
+                pending or running
+                or (searcher is not None and suggested < cfg.num_samples)
+            ):
+                while len(running) < cfg.max_concurrent_trials:
+                    trial = next_trial()
+                    if trial is None:
+                        break
                     launch(trial)
                     running.append(trial)
                 for trial in list(running):
@@ -315,6 +374,7 @@ class Tuner:
                             running.append(trial)
                         else:
                             trial.state = TERMINATED
+                            trial_finished(trial)
                         self._save_state(storage)
                         continue
                     if reply["done"] is not None:
@@ -329,6 +389,7 @@ class Tuner:
                             trial.error = repr(reply["error"])
                         else:
                             trial.state = TERMINATED
+                        trial_finished(trial)
                         self._save_state(storage)
         finally:
             for trial in running:

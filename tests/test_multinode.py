@@ -48,6 +48,33 @@ def test_spillback_to_fitting_node(rt_cluster):
     assert "node-1" in socket
 
 
+def test_broadcast_to_many_nodes(rt_cluster):
+    """One producer, consumers on several nodes: every node pulls the
+    full object correctly (pipelined chunk window + randomized source
+    selection — PushManager-style broadcast spread)."""
+    rt, cluster = rt_cluster
+    for i in range(3):
+        cluster.add_node(num_cpus=1, resources={f"n{i}": 1.0})
+    cluster.wait_for_nodes(4)
+
+    @rt.remote(resources={"n0": 0.5})
+    def produce():
+        return np.arange(2_000_000, dtype=np.float64)  # ~16 MB
+
+    ref = produce.remote()
+
+    @rt.remote
+    def check(x):
+        return float(x[1_234_567]) == 1_234_567.0 and x.nbytes
+
+    checks = [
+        check.options(resources={f"n{i}": 1.0}).remote(ref)
+        for i in range(3)
+    ]
+    results = rt.get(checks, timeout=120)
+    assert all(r == 16_000_000 for r in results), results
+
+
 def test_cross_node_large_object_transfer(rt_cluster):
     rt, cluster = rt_cluster
     node = cluster.add_node(num_cpus=2, resources={"special": 2.0})

@@ -173,3 +173,112 @@ def test_tuner_wraps_jax_trainer(rt_session):
     assert not results.errors
     best = results.get_best_result("loss", "min")
     assert best.config["lr_scale"] == 2.0
+
+
+# ---------------------------------------------------------------------
+# Adaptive search (TPE) — reference slot: tune/search/optuna, hyperopt
+# ---------------------------------------------------------------------
+
+
+def test_tpe_converges_on_quadratic():
+    """TPE's suggestions must concentrate near the optimum and beat
+    pure random search on the same budget + seed."""
+    import random as pyrandom
+
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    space = {"x": uniform(0.0, 1.0), "y": uniform(0.0, 1.0)}
+
+    def score(cfg):
+        return -((cfg["x"] - 0.7) ** 2 + (cfg["y"] - 0.2) ** 2)
+
+    def run(adaptive, seed):
+        if not adaptive:
+            rng = pyrandom.Random(seed)
+            return max(
+                score({"x": rng.uniform(0, 1), "y": rng.uniform(0, 1)})
+                for _ in range(40)
+            ), []
+        s = TPESearcher()
+        s.setup(space, metric="score", mode="max", seed=seed)
+        best, xs = -1e9, []
+        for _ in range(40):
+            cfg = s.suggest()
+            xs.append(cfg["x"])
+            val = score(cfg)
+            best = max(best, val)
+            s.record(cfg, {"score": val})
+        return best, xs
+
+    seeds = range(5)
+    tpe_runs = [run(True, s) for s in seeds]
+    rand_runs = [run(False, s) for s in seeds]
+    # On average over seeds TPE beats random on the same budget (any
+    # single seed can get lucky either way; 2-D is where model-based
+    # search separates from best-of-N sampling).
+    tpe_mean = sum(b for b, _ in tpe_runs) / len(seeds)
+    rand_mean = sum(b for b, _ in rand_runs) / len(seeds)
+    assert tpe_mean >= rand_mean, (tpe_mean, rand_mean)
+    assert all(b > -0.02 for b, _ in tpe_runs), tpe_runs
+    # Later suggestions concentrate near the optimum vs the startup
+    # phase.
+    for _, xs in tpe_runs:
+        early = sum(abs(x - 0.7) for x in xs[:10]) / 10
+        late = sum(abs(x - 0.7) for x in xs[-10:]) / 10
+        assert late < early, (early, late)
+
+
+def test_tpe_handles_choice_and_loguniform():
+    from ray_tpu.tune.search import TPESearcher, choice, loguniform
+
+    space = {"lr": loguniform(1e-5, 1e-1), "act": choice(["a", "b", "c"])}
+    s = TPESearcher(n_startup=8)
+    s.setup(space, metric="loss", mode="min", seed=1)
+    for _ in range(30):
+        cfg = s.suggest()
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert cfg["act"] in ("a", "b", "c")
+        # Optimum: lr near 1e-3, act == "b".
+        import math as m
+
+        loss = (m.log10(cfg["lr"]) + 3) ** 2 + (0.0 if cfg["act"] == "b" else 1.0)
+        s.record(cfg, {"loss": loss})
+    # The model should now strongly prefer act="b".
+    prefs = [s.suggest()["act"] for _ in range(20)]
+    assert prefs.count("b") >= 10, prefs
+
+
+def test_tpe_rejects_grid_axes():
+    import pytest as _pytest
+
+    from ray_tpu.tune.search import TPESearcher, grid_search
+
+    s = TPESearcher()
+    with _pytest.raises(ValueError, match="grid_search"):
+        s.setup({"x": grid_search([1, 2])}, "score", "max")
+
+
+def test_tuner_with_tpe_search_alg(rt_session):
+    """End-to-end: Tuner drives TPE suggestions adaptively and finds a
+    good config (BOHB-style composition: searcher + ASHA scheduler)."""
+    rt = rt_session
+    from ray_tpu import tune
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    def trainable(config):
+        tune.report({"score": -((config["x"] - 0.3) ** 2)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=20,
+            max_concurrent_trials=2,
+            search_alg=TPESearcher(n_startup=6),
+            seed=3,
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 20
+    best = grid.get_best_result(metric="score", mode="max")
+    assert abs(best.config["x"] - 0.3) < 0.15, best.config
