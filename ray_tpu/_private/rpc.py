@@ -20,17 +20,27 @@ Addresses
 
 Wire format & authentication
 ----------------------------
-On accept, the server sends a random 16-byte connection nonce
-(``[8-byte length][nonce]``); both sides derive the connection key
+On accept, the server sends a hello
+(``[8-byte length][16-byte nonce][1-byte protocol version]``); the
+client verifies the version (mismatch -> clean RpcError) and both
+sides derive the connection key
 ``HMAC(cluster_key, b"rt-conn" || nonce)``. Every subsequent frame is
-``[8-byte length][32-byte HMAC-SHA256][pickled dict]`` keyed by the
-connection key and verified BEFORE unpickling — unauthenticated peers
-cannot reach the deserializer (which is what makes a pickle wire
-format tolerable on TCP, VERDICT weak #9), and a frame captured on
-one connection cannot be replayed on another (different nonce). A
-frame that fails verification terminates the connection. The cluster
-key comes from ``auth_key`` / ``RT_AUTH_TOKEN``; daemons refuse to
-bind TCP with the well-known local default (they auto-generate, see
+
+    [8-byte length][32-byte HMAC-SHA256][payload]
+    payload = [4-byte envelope len][protobuf Frame envelope][body]
+
+(see wire.py / protocol.proto): the envelope carries version, method,
+correlation id, and push channel in a typed protobuf schema; the body
+is the pickled argument/reply dict, placed out of band so large object
+chunks decode zero-copy. The HMAC is keyed by the connection key and
+verified BEFORE any decoding — unauthenticated peers cannot reach the
+deserializer (which is what makes a pickle body tolerable on TCP),
+and a frame captured on one connection cannot be replayed on another
+(different nonce). A frame that fails verification terminates the
+connection. Server-side, every request is validated against its
+per-method schema (wire.SCHEMAS) before dispatch. The cluster key
+comes from ``auth_key`` / ``RT_AUTH_TOKEN``; daemons refuse to bind
+TCP with the well-known local default (they auto-generate, see
 NodeDaemon). Every message carries `_mid` (correlation id); server
 replies echo it; unsolicited pushes use `_mid = -1` and a `_push`
 channel name.
@@ -47,6 +57,14 @@ import struct
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from .wire import (
+    PROTOCOL_VERSION,
+    ProtocolVersionError,
+    decode_frame,
+    encode_frame,
+)
+from .wire import validate as _schema_validate
 
 _LEN = struct.Struct(">Q")
 _DIGEST_BYTES = 32
@@ -167,7 +185,7 @@ def _chaos_should_fail(method: str) -> bool:
 # ---------------------------------------------------------------------------
 
 def send_msg(sock: socket.socket, msg: dict, key: bytes) -> None:
-    payload = pickle.dumps(msg, protocol=5)
+    payload = encode_frame(msg)
     digest = _hmac.new(key, payload, hashlib.sha256).digest()
     try:
         sock.sendall(_LEN.pack(len(payload)) + digest + payload)
@@ -188,9 +206,14 @@ def recv_msg(sock: socket.socket, key: bytes) -> Optional[dict]:
         return None
     expect = _hmac.new(key, payload, hashlib.sha256).digest()
     if not _hmac.compare_digest(digest, expect):
-        # Unauthenticated frame: never reaches pickle; kill the peer.
+        # Unauthenticated frame: never reaches the decoder.
         return None
-    return pickle.loads(payload)
+    try:
+        return decode_frame(payload)
+    except Exception:
+        # Malformed or wrong-version frame from an authenticated peer
+        # (should have been caught at handshake): kill the connection.
+        return None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -304,6 +327,26 @@ class RpcServer:
             if mid:
                 conn.reply(mid, {"_error": f"no such method: {method}"})
             return
+        # Typed argument validation (wire.SCHEMAS): malformed frames
+        # get a clean schema error instead of a KeyError mid-handler.
+        schema_err = _schema_validate(method, msg)
+        if schema_err is not None:
+            if mid:
+                conn.reply(
+                    mid, {"_error": f"schema violation: {schema_err}"}
+                )
+            else:
+                # A dropped NOTIFY is invisible to the sender — always
+                # a framework bug (schemas describe our own senders),
+                # so make it loud instead of wedging silently.
+                import sys as _sys
+
+                print(
+                    f"[rpc] dropping notify with schema violation: "
+                    f"{schema_err}",
+                    file=_sys.stderr,
+                )
+            return
         try:
             result = handler(conn, msg)
         except Exception as e:  # noqa: BLE001 — errors propagate to caller
@@ -379,11 +422,14 @@ class Connection:
     def serve(self) -> None:
         # Nonce handshake: frames on this connection are keyed by
         # HMAC(cluster_key, nonce), so a frame recorded on another
-        # connection can't be replayed here.
+        # connection can't be replayed here. The trailing byte carries
+        # the protocol version (reference: versioned proto schemas) —
+        # mismatched peers fail at connect with a clear error.
         nonce = os.urandom(16)
+        hello = nonce + bytes([PROTOCOL_VERSION])
         try:
             with self._send_lock:
-                self._sock.sendall(_LEN.pack(len(nonce)) + nonce)
+                self._sock.sendall(_LEN.pack(len(hello)) + hello)
         except OSError:
             self._server._on_disconnect(self)
             return
@@ -508,22 +554,38 @@ class RpcClient:
             try:
                 sock.connect(target)
                 # Client half of the nonce handshake (see module
-                # docstring / Connection.serve): read [8-byte len][nonce]
-                # and key every subsequent frame on this socket with
-                # HMAC(cluster_key, "rt-conn"||nonce).
+                # docstring / Connection.serve): read [8-byte len]
+                # [16-byte nonce][1-byte protocol version], verify the
+                # version, and key every subsequent frame on this
+                # socket with HMAC(cluster_key, "rt-conn"||nonce).
                 prev_timeout = sock.gettimeout()
                 sock.settimeout(max(deadline - time.time(), 1.0))
                 header = _recv_exact(sock, _LEN.size)
                 if header is None:
                     raise ConnectionResetError("no nonce from server")
                 (nlen,) = _LEN.unpack(header)
-                if nlen == 0 or nlen > 64:
+                if nlen < 17 or nlen > 64:
                     raise ConnectionResetError(
-                        f"bad nonce length {nlen} from server"
+                        f"bad hello length {nlen} from server "
+                        "(pre-versioning peer?)"
                     )
-                nonce = _recv_exact(sock, nlen)
-                if nonce is None:
-                    raise ConnectionResetError("truncated nonce")
+                hello = _recv_exact(sock, nlen)
+                if hello is None:
+                    raise ConnectionResetError("truncated hello")
+                nonce, version = hello[:16], hello[16]
+                if version != PROTOCOL_VERSION:
+                    sock.close()
+                    # RpcError (not the wire-level ProtocolVersionError)
+                    # so every existing `except RpcError` boundary in
+                    # the daemons handles the mismatch cleanly instead
+                    # of dying on an unexpected exception type; the
+                    # non-OSError type also breaks out of the connect
+                    # retry loop immediately.
+                    raise RpcError(
+                        f"protocol version mismatch: server speaks "
+                        f"v{version}, this client speaks "
+                        f"v{PROTOCOL_VERSION}"
+                    )
                 sock.settimeout(prev_timeout)
                 return sock, _connection_key(self.auth_key, nonce)
             except (

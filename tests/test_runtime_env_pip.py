@@ -130,10 +130,14 @@ def test_conda_uv_still_rejected(single_worker):
         rt.get(f.remote(), timeout=30)
 
 
-def test_bad_requirement_surfaces_setup_error(single_worker):
-    @rt.remote(
-        runtime_env={"pip": ["/nonexistent/definitely_missing.whl"]}
-    )
+def test_bad_requirement_surfaces_setup_error(single_worker, tmp_path):
+    # A corrupt local wheel fails pip fast and fully offline (a
+    # nonexistent requirement name would stall in index retries in
+    # this zero-egress environment).
+    bad = tmp_path / "broken_pkg-0.1-py3-none-any.whl"
+    bad.write_bytes(b"this is not a zip archive")
+
+    @rt.remote(runtime_env={"pip": [str(bad)]})
     def f():
         return 1
 
