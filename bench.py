@@ -259,6 +259,10 @@ def run_micro() -> dict:
         rt.get([nop.remote() for _ in range(8)], timeout=60)
 
         # 2. pipelined task throughput
+        # Note: this burst pays cold worker spawns inside the timed
+        # window (500 tasks fan out to the whole pool), so it can read
+        # BELOW the hot single-worker roundtrip number above — that is
+        # a real cost profile, not a key mix-up.
         t0 = time.perf_counter()
         refs = [nop.remote() for _ in range(500)]
         rt.get(refs, timeout=120)

@@ -15,7 +15,12 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libray_tpu_store.so")
+#: RT_NATIVE_SO overrides the library path (the sanitizer test points
+#: it at an ASan/UBSan-instrumented build; make is skipped then). One
+#: import-time snapshot drives BOTH the path and the skip-make
+#: decision so they can never disagree.
+_SO_OVERRIDE = os.environ.get("RT_NATIVE_SO")
+_SO = _SO_OVERRIDE or os.path.join(_DIR, "libray_tpu_store.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -41,17 +46,19 @@ def load_library() -> Optional[ctypes.CDLL]:
             return _lib
         # Always invoke make: it no-ops when the .so is fresh and
         # rebuilds when store.cc changed (a stale .so must never load).
-        try:
-            subprocess.run(
-                ["make", "-C", _DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except Exception:
-            if not os.path.exists(_SO):
-                _load_failed = True
-                return None
+        # An RT_NATIVE_SO override is loaded as-is (pre-built).
+        if _SO_OVERRIDE is None:
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                if not os.path.exists(_SO):
+                    _load_failed = True
+                    return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
@@ -86,6 +93,13 @@ def load_library() -> Optional[ctypes.CDLL]:
         ]
         lib.rts_pin.restype = ctypes.c_int64
         lib.rts_pin.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rts_seal_pinned.restype = ctypes.c_int64
+        lib.rts_seal_pinned.argtypes = [
             ctypes.c_void_p,
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint64),
@@ -238,6 +252,30 @@ class NativeArena:
             if self._closed:
                 return None
             index = self._lib.rts_pin(
+                self._handle,
+                self._key(oid),
+                ctypes.byref(offset),
+                ctypes.byref(size),
+            )
+            if index < 0:
+                return None
+            n = int(size.value)
+            return (
+                int(index),
+                self._view(int(offset.value), max(n, 1))[:n],
+            )
+
+    def seal_pinned(self, oid: bytes):
+        """Seal the CREATING slot and take a reader pin in one
+        critical section (see rts_seal_pinned: closes the window where
+        a freshly sealed, pin-less slot is an LRU victim before its
+        owner can protect it). Returns (slot_index, view) or None."""
+        offset = ctypes.c_uint64(0)
+        size = ctypes.c_uint64(0)
+        with self._call_lock:
+            if self._closed:
+                return None
+            index = self._lib.rts_seal_pinned(
                 self._handle,
                 self._key(oid),
                 ctypes.byref(offset),

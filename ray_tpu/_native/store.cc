@@ -444,6 +444,51 @@ int rts_seal(void* handle, const uint8_t* oid) {
   return RTS_OK;
 }
 
+// Pin accounting for one slot (caller holds the arena lock): ledger
+// record for crash reclaim, pin count, LRU touch, and the caller's
+// view coordinates. Shared by rts_pin and rts_seal_pinned.
+int64_t PinSlotLocked(Handle* h, Slot* slot, uint64_t* offset_out,
+                      uint64_t* size_out) {
+  int32_t index = static_cast<int32_t>(slot - h->slots);
+  int32_t pid = static_cast<int32_t>(getpid());
+  PinRec* rec = FindPinRec(h, pid, index);
+  if (rec == nullptr) rec = AllocPinRec(h, index);
+  if (rec != nullptr) {
+    if (!rec->in_use) {
+      rec->in_use = 1;
+      rec->pid = pid;
+      rec->slot = index;
+      rec->count = 0;
+    }
+    rec->count += 1;
+  } else {
+    // Bucket exhaustion: still pin (reader safety beats reclaim).
+    h->header->untracked_pins += 1;
+  }
+  slot->pins += 1;
+  slot->lru_tick = ++h->header->lru_clock;
+  *offset_out = slot->offset;
+  *size_out = slot->size;
+  return index;
+}
+
+// Seal + take a reader pin in ONE critical section. A creator that
+// seals then pins in two calls leaves a window where the brand-new
+// SEALED slot (pins == 0) is an LRU-eviction candidate — a concurrent
+// create() in another process could destroy the only copy before the
+// daemon's primary pin lands. Returns the slot index (>= 0) for
+// rts_unpin_idx, with offset/size for the caller's view.
+int64_t rts_seal_pinned(void* handle, const uint8_t* oid,
+                        uint64_t* offset_out, uint64_t* size_out) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Slot* slot = FindSlot(h, oid);
+  if (slot == nullptr) return RTS_ERR_MISSING;
+  if (slot->state != kCreating) return RTS_ERR_STATE;
+  slot->state = kSealed;
+  return PinSlotLocked(h, slot, offset_out, size_out);
+}
+
 // Looks up a SEALED object; returns offset, fills size. -4 if absent
 // or unsealed (sealed_only=0 accepts CREATING too).
 int64_t rts_lookup(void* handle, const uint8_t* oid, uint64_t* size_out,
@@ -473,27 +518,7 @@ int64_t rts_pin(void* handle, const uint8_t* oid, uint64_t* offset_out,
   Slot* slot = FindSlot(h, oid);
   if (slot == nullptr) return RTS_ERR_MISSING;
   if (slot->state != kSealed) return RTS_ERR_STATE;
-  int32_t index = static_cast<int32_t>(slot - h->slots);
-  int32_t pid = static_cast<int32_t>(getpid());
-  PinRec* rec = FindPinRec(h, pid, index);
-  if (rec == nullptr) rec = AllocPinRec(h, index);
-  if (rec != nullptr) {
-    if (!rec->in_use) {
-      rec->in_use = 1;
-      rec->pid = pid;
-      rec->slot = index;
-      rec->count = 0;
-    }
-    rec->count += 1;
-  } else {
-    // Bucket exhaustion: still pin (reader safety beats reclaim).
-    h->header->untracked_pins += 1;
-  }
-  slot->pins += 1;
-  slot->lru_tick = ++h->header->lru_clock;
-  *offset_out = slot->offset;
-  *size_out = slot->size;
-  return index;
+  return PinSlotLocked(h, slot, offset_out, size_out);
 }
 
 int rts_unpin_idx(void* handle, int32_t index) {

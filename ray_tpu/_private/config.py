@@ -78,6 +78,10 @@ class Config:
     scheduler_top_k_fraction: float = 0.2
     #: Max worker processes kept warm per node. 0 = num_cpus.
     worker_pool_max_idle_workers: int = 2
+    #: Worker processes spawned at daemon start so the first task
+    #: skips the ~0.2s cold spawn (reference: WorkerPool prestart,
+    #: worker_pool.cc PrestartWorkers / RAY_prestart_worker_first_driver).
+    worker_prestart_count: int = 1
     #: Seconds an idle leased worker is kept before being returned.
     worker_lease_idle_timeout_s: float = 1.0
     #: Direct task transport: drivers lease workers and push task specs

@@ -410,16 +410,28 @@ class NodeDaemon:
         self.server.start()
         if self.is_head:
             self._redispatch_restored_creations()
-        if self.spill is not None:
-            threading.Thread(
-                target=self._spill_loop, daemon=True,
-                name=f"spill:{self.node_id.hex()[:8]}",
-            ).start()
+        threading.Thread(
+            target=self._maintenance_loop, daemon=True,
+            name=f"maint:{self.node_id.hex()[:8]}",
+        ).start()
         if self.config.log_to_driver:
             threading.Thread(
                 target=self._log_monitor_loop, daemon=True,
                 name=f"logs:{self.node_id.hex()[:8]}",
             ).start()
+        # Prestart under the lock (matching every other _spawn_worker
+        # call site — _spawning is a plain counter), clamped so at
+        # least one pool slot stays free for a differently-typed (TPU)
+        # worker: prestarted workers are CPU-type and nothing reaps
+        # idle workers, so filling the pool would starve TPU tasks.
+        with self._lock:
+            headroom = max(0, self._max_workers - 1) - len(
+                self.workers
+            ) - self._spawning
+            for _ in range(
+                min(self.config.worker_prestart_count, max(0, headroom))
+            ):
+                self._spawn_worker()
         if self.config.memory_monitor_refresh_ms > 0:
             from .memory_monitor import MemoryMonitor
 
@@ -1184,13 +1196,39 @@ class NodeDaemon:
     # local_object_manager.h:110 SpillObjectsOfSize; restore path
     # AsyncRestoreSpilledObject; storage external_storage.py:72)
     # ------------------------------------------------------------------
-    def _pin_primary(self, oid: ObjectID, size: int) -> None:
-        """Pin a locally-sealed (primary) copy against eviction."""
+    _PIN_ABSENT = object()
+
+    def _pin_primary(
+        self, oid: ObjectID, size: int, pin=None
+    ) -> None:
+        """Pin a locally-sealed (primary) copy against eviction.
+        `pin` carries a ready ArenaPin taken atomically at seal time
+        (seal_pinned) — adopted instead of acquiring a fresh one.
+
+        Entry protocol for self._primary_pins[oid]:
+          absent       — unprotected
+          None         — reservation: some thread is acquiring a pin
+          pin object   — protected
+        A ready pin FILLS a pending reservation (releasing it there
+        would reopen the zero-pin eviction window while the reserver
+        is still acquiring); the reserver only installs its own pin if
+        the entry is still its empty reservation, else releases it.
+        """
         with self._lock:
-            if oid in self._primary_pins:
+            existing = self._primary_pins.get(oid, self._PIN_ABSENT)
+            if existing is None:
+                # Pending reservation from another thread.
+                if pin is not None:
+                    self._primary_pins[oid] = pin  # fill it
+                return  # (reserver will see the fill and stand down)
+            if existing is not self._PIN_ABSENT:
+                if pin is not None:
+                    self._release_pin(pin)  # truly already protected
                 return
-            self._primary_pins[oid] = None  # reserve against races
-        pin = None
+            self._primary_pins[oid] = pin  # pin, or None = reservation
+            if pin is not None:
+                return
+        # We hold the empty reservation: acquire outside the lock.
         if getattr(self.store, "needs_release", False):
             pin = self.store.acquire(oid, timeout=0)
         else:
@@ -1199,30 +1237,35 @@ class NodeDaemon:
                     self.store.open_remote(oid, size)
                 except FileNotFoundError:
                     with self._lock:
-                        self._primary_pins.pop(oid, None)
+                        if self._primary_pins.get(oid) is None:
+                            self._primary_pins.pop(oid, None)
                     return
             self.store.pin(oid)
             pin = oid  # marker: pinned in the py store
         stale = False
         with self._lock:
-            if oid not in self._primary_pins:
-                # Object was deleted while we acquired: a concurrent
-                # _unpin_primary consumed the reservation. Inserting
-                # now would leak the pin (and block the arena's
-                # deferred delete) forever — release it instead.
+            current = self._primary_pins.get(oid, self._PIN_ABSENT)
+            if current is None:
+                # Still our empty reservation.
+                if pin is None:
+                    self._primary_pins.pop(oid, None)
+                else:
+                    self._primary_pins[oid] = pin
+            else:
+                # Deleted concurrently (absent) or a seal-time pin
+                # filled the reservation first — our pin is surplus.
                 stale = True
-            elif pin is None:
-                self._primary_pins.pop(oid, None)
-            else:
-                self._primary_pins[oid] = pin
         if stale and pin is not None:
-            if getattr(self.store, "needs_release", False):
-                try:
-                    pin.release()
-                except Exception:
-                    pass
-            else:
-                self.store.unpin(oid)
+            self._release_pin(pin)
+
+    def _release_pin(self, pin) -> None:
+        if getattr(self.store, "needs_release", False):
+            try:
+                pin.release()
+            except Exception:
+                pass
+        else:
+            self.store.unpin(pin)  # py-store marker IS the oid
 
     def _unpin_primary(self, oid: ObjectID) -> None:
         with self._lock:
@@ -1397,8 +1440,28 @@ class NodeDaemon:
             })
         return batches
 
-    def _spill_loop(self) -> None:
+    def _maintenance_loop(self) -> None:
+        """Periodic store upkeep on EVERY daemon (the head included —
+        worker nodes additionally reap via their heartbeat loop):
+        reclaim arena pins of crashed/killed reader processes, then
+        spill under pressure. A dead reader's pin otherwise defers
+        deletion forever and leaks the slot."""
         while not self._shutdown:
+            # Reap zombie worker children FIRST: a SIGKILLed worker
+            # stays a zombie until waitpid, and the arena's pid-liveness
+            # check (kill(pid, 0)) reports zombies as alive — its pins
+            # would defer slot frees forever.
+            for proc in list(self._worker_procs):
+                try:
+                    proc.poll()
+                except Exception:
+                    pass
+            reap = getattr(self.store, "reap_dead_pins", None)
+            if reap is not None:
+                try:
+                    reap()
+                except Exception:
+                    pass
             try:
                 self._maybe_spill()
             except Exception:
@@ -1485,13 +1548,27 @@ class NodeDaemon:
         data = self.spill.read(oid)
         if data is None:
             return False
+        pin = None
+
+        def _put_pinned():
+            # seal_pinned (arena) closes the window where the restored
+            # copy is sealed but not yet primary-pinned and a foreign
+            # create() LRU-evicts it again.
+            buf = self.store.create(oid, len(data))
+            buf[: len(data)] = data
+            seal_pinned = getattr(self.store, "seal_pinned", None)
+            if seal_pinned is not None:
+                return seal_pinned(oid)
+            self.store.seal(oid)
+            return None
+
         try:
             try:
-                self.store.put(oid, data)
+                pin = _put_pinned()
             except ObjectStoreFullError:
                 # Make room by spilling colder objects, then retry once.
                 self._maybe_spill(bytes_needed=len(data))
-                self.store.put(oid, data)
+                pin = _put_pinned()
         except ValueError:
             pass  # already (re-)created by a concurrent restore
         except ObjectStoreFullError:
@@ -1503,7 +1580,7 @@ class NodeDaemon:
             entry.state = SEALED
             if self.is_head:
                 entry.locations.add(self.node_id.binary())
-        self._pin_primary(oid, len(data))
+        self._pin_primary(oid, len(data), pin=pin)
         return True
 
     # -- cross-node pull -------------------------------------------------

@@ -415,6 +415,30 @@ class NativeArenaStore:
         if event is not None:
             event.set()
 
+    def seal_pinned(self, object_id: ObjectID) -> Optional[ArenaPin]:
+        """Seal + creator pin in one arena critical section: a fresh
+        SEALED slot with zero pins is an LRU victim, so the creator
+        holds this pin until the daemon's primary pin is registered
+        (closes the seal->report eviction window). Seal failures raise
+        exactly like seal() — a silent None here would let callers
+        report an object that doesn't exist."""
+        pinned = self._arena.seal_pinned(object_id.binary())
+        if pinned is None:
+            # Surface the real error (missing slot / bad state) with
+            # seal()'s raising semantics; the seal event stays unset.
+            self._arena.seal(object_id.binary())
+            # seal() somehow succeeded after seal_pinned failed (can
+            # only happen if the two raced a delete+recreate): sealed,
+            # but no pin to hand out.
+        with self._lock:
+            event = self._seal_events.pop(object_id, None)
+        if event is not None:
+            event.set()
+        if pinned is None:
+            return None
+        index, view = pinned
+        return ArenaPin(self._arena, view, index)
+
     def put(self, object_id: ObjectID, data) -> None:
         buf = self.create(object_id, len(data))
         buf[: len(data)] = data

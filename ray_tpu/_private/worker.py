@@ -287,14 +287,42 @@ class CoreWorker:
         """create() with spill-on-full: if the store can't make room by
         evicting, ask the daemon to spill cold objects to disk and retry
         (reference: plasma create retries after the raylet spills,
-        create_request_queue.h)."""
+        create_request_queue.h). Bounded retries: under concurrent
+        producers the freed space can be claimed before our retry."""
+        last: Exception = None
+        for attempt in range(4):
+            try:
+                return self.store.create(oid, size)
+            except ObjectStoreFullError as e:
+                last = e
+                if attempt == 3:
+                    break  # no retry left: don't pay one more spill
+                self._client.call(
+                    "spill_request", bytes_needed=size, timeout=60.0
+                )
+                if attempt:
+                    time.sleep(0.05 * attempt)
+        raise last
+
+    def _seal_and_report(self, oid: ObjectID, used: int) -> None:
+        """Seal a just-written object and report it to the daemon. On
+        the shared arena the seal takes a creator pin held until the
+        daemon's primary pin is in place — otherwise another process's
+        create() could LRU-evict the brand-new (pin-less) object in
+        that window, losing the only copy."""
+        pin = None
+        seal_pinned = getattr(self.store, "seal_pinned", None)
+        if seal_pinned is not None:
+            pin = seal_pinned(oid)
+        else:
+            self.store.seal(oid)
         try:
-            return self.store.create(oid, size)
-        except ObjectStoreFullError:
             self._client.call(
-                "spill_request", bytes_needed=size, timeout=60.0
+                "object_sealed", oid=oid.binary(), size=used
             )
-            return self.store.create(oid, size)
+        finally:
+            if pin is not None:
+                pin.release()
 
     def put_object(
         self, oid: ObjectID, value: Any, cache: bool = False
@@ -321,8 +349,7 @@ class CoreWorker:
         self.flush_pending_dels()
         buf = self._store_create(oid, size)
         used = serialized.write_to(buf)
-        self.store.seal(oid)
-        self._client.call("object_sealed", oid=oid.binary(), size=used)
+        self._seal_and_report(oid, used)
         return ("shm", used)
 
     def get(
@@ -629,10 +656,7 @@ class CoreWorker:
                 oid = self._next_put_id()
                 buf = self._store_create(oid, size)
                 used = serialized.write_to(buf)
-                self.store.seal(oid)
-                self._client.call(
-                    "object_sealed", oid=oid.binary(), size=used
-                )
+                self._seal_and_report(oid, used)
                 out.append(("ref", oid.binary()))
         return out
 
@@ -1029,10 +1053,7 @@ class CoreWorker:
                         oid = ObjectID(oid_bytes)
                         buf = self._store_create(oid, size)
                         used = serialized.write_to(buf)
-                        self.store.seal(oid)
-                        self._client.call(
-                            "object_sealed", oid=oid_bytes, size=used
-                        )
+                        self._seal_and_report(oid, used)
                         wire.append(("shm", used))
             except BaseException as e:  # noqa: BLE001
                 self._report_direct_task_events(spec, start_time, True)

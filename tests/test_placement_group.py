@@ -247,7 +247,15 @@ def test_head_only_pending_pg_retries_on_capacity_free(rt_session):
     ref = hog.remote()
     import time as _t
 
-    _t.sleep(0.3)  # hog is running, 1 of 4 CPUs free
+    # Wait until hog's resources are actually RESERVED (lease grants
+    # reserve at worker registration, not submit — a fixed sleep races
+    # worker spawn latency).
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        if rt.available_resources().get("CPU", 4.0) <= 1.0:
+            break
+        _t.sleep(0.05)
+    assert rt.available_resources().get("CPU", 4.0) <= 1.0
     pg = placement_group([{"CPU": 3.0}], strategy="PACK")
     assert pg.state() == "PENDING"
     assert rt.get(ref, timeout=20) == "done"

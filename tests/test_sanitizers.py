@@ -1,0 +1,129 @@
+"""Sanitizer pass over the native arena store (SURVEY §5.2 — the
+reference ships ASAN/UBSAN/TSAN build modes and sanitizer CI for its
+C++ core; here the C++ surface is store.cc, exercised under
+AddressSanitizer + UndefinedBehaviorSanitizer in a subprocess with the
+sanitizer runtime preloaded)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ray_tpu", "_native",
+)
+# Keyed to this checkout so parallel worktrees/users never share (or
+# fight over) one binary.
+import hashlib as _hashlib
+
+_SAN_SO = "/tmp/rt_store_sanitized_{}.so".format(
+    _hashlib.sha1(_NATIVE_DIR.encode()).hexdigest()[:10]
+)
+
+# The exercise runs in a subprocess (the sanitizer runtime must be
+# preloaded before python starts) and sweeps the arena API: create /
+# seal / pin / read / delete, LRU eviction under pressure, delete-vs-
+# pin deferral, crash-reaping of a dead child's pins, reopen.
+_EXERCISE = r"""
+import ctypes, os, sys
+sys.path.insert(0, %(repo)r)
+from ray_tpu._native import NativeArena
+
+path = "/dev/shm/rt_asan_test_%%d" %% os.getpid()
+arena = NativeArena(path, 1 << 20, create=True)  # 1 MiB
+oid = lambda i: bytes([i %% 256]) * 20
+
+# fill beyond capacity -> LRU eviction
+for i in range(40):
+    view, evicted = arena.create(oid(i), 40_000)
+    view[:5] = b"hello"
+    arena.seal(oid(i))
+assert arena.stats()["used"] <= arena.stats()["capacity"]
+
+# pinned reads survive delete (deferred free) and release cleanly
+pin = arena.try_pin(oid(39))
+assert pin is not None
+index, view = pin
+assert bytes(view[:5]) == b"hello"
+arena.delete(oid(39))
+assert bytes(view[:5]) == b"hello"  # still mapped while pinned
+arena.unpin_idx(index)
+
+# a child process pins and dies without releasing; the parent reaps
+child = os.fork()
+if child == 0:
+    a2 = NativeArena(path, 1 << 20, create=False)
+    a2.try_pin(oid(38))
+    os._exit(0)  # dies holding the pin
+os.waitpid(child, 0)
+reaped = arena.reap_dead_pins()
+assert reaped >= 1, reaped
+
+# delete/recreate same oid (ABA) and reopen the arena
+arena.delete(oid(38))
+v, _ = arena.create(oid(38), 128)
+v[:3] = b"new"
+arena.seal(oid(38))
+arena.close(unlink=False)
+arena = NativeArena(path, 1 << 20, create=False)
+p = arena.try_pin(oid(38))
+assert p is not None and bytes(p[1][:3]) == b"new"
+arena.unpin_idx(p[0])
+arena.close(unlink=True)
+print("SANITIZED-SWEEP-OK")
+"""
+
+
+@pytest.fixture(scope="module")
+def sanitized_so():
+    src = os.path.join(_NATIVE_DIR, "store.cc")
+    if (
+        not os.path.exists(_SAN_SO)
+        or os.path.getmtime(_SAN_SO) < os.path.getmtime(src)
+    ):
+        build = subprocess.run(
+            [
+                "g++", "-O1", "-g", "-fPIC", "-std=c++17", "-shared",
+                "-fsanitize=address,undefined",
+                "-fno-sanitize-recover=all",
+                src, "-o", _SAN_SO, "-lpthread",
+            ],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert build.returncode == 0, build.stderr[-2000:]
+    return _SAN_SO
+
+
+def _libasan() -> str:
+    out = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"],
+        capture_output=True, text=True,
+    ).stdout.strip()
+    if not out or not os.path.exists(out):
+        pytest.skip("libasan runtime not found")
+    return out
+
+
+def test_arena_sweep_under_asan_ubsan(sanitized_so):
+    repo = os.path.dirname(_NATIVE_DIR.rstrip(os.sep))
+    repo = os.path.dirname(repo)
+    env = dict(
+        os.environ,
+        RT_NATIVE_SO=sanitized_so,
+        LD_PRELOAD=_libasan(),
+        # Python itself leaks at exit by design; the arena file is a
+        # persistent resource. Halt on real errors only.
+        ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+        UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _EXERCISE % {"repo": repo}],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    output = proc.stdout + proc.stderr
+    assert proc.returncode == 0, output[-4000:]
+    assert "SANITIZED-SWEEP-OK" in output, output[-4000:]
+    for marker in ("AddressSanitizer", "runtime error", "SUMMARY:"):
+        assert marker not in output, output[-4000:]
