@@ -59,7 +59,7 @@ def _layer_with_cache(
 ):
     b, t, _ = x.shape
     hd = cfg.head_dim
-    h = rms_norm(x, layer["attn_norm"])
+    h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
     q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
     k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
     v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
@@ -95,7 +95,7 @@ def _layer_with_cache(
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vf.astype(jnp.float32))
     attn = attn.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(b, t, -1)
     x = x + attn @ layer["wo"]
-    h = rms_norm(x, layer["mlp_norm"])
+    h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
     x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
     return x, k_cache, v_cache
 
@@ -121,7 +121,7 @@ def _forward_with_cache(
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = rms_norm(x, params["final_norm"])
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "length": cache["length"]}
 

@@ -1,0 +1,153 @@
+"""HuggingFace Llama checkpoint conversion.
+
+The integration-parity role of the reference's framework adapters
+(reference: python/ray/train/huggingface/ — Ray Train wraps HF
+Trainer/accelerate; SURVEY §2.3 Train-integrations row): here the
+integration is TPU-first — convert an HF `LlamaForCausalLM` state
+dict into this framework's stacked-scan parameter pytree and run it
+on the JAX/Pallas stack. tests/test_hf_parity.py proves numerical
+parity of the full forward (logits) against transformers' reference
+implementation.
+
+Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
+so activations right-multiply):
+  q/k/v/o_proj.weight.T     -> wq/wk/wv/wo
+  gate_proj.weight.T        -> w3   (our swiglu(x, gate) gates arg 2)
+  up_proj.weight.T          -> w1
+  down_proj.weight.T        -> w2
+  embed_tokens.weight       -> embed           [vocab, dim]
+  lm_head.weight.T          -> lm_head         [dim, vocab]
+RoPE uses the same half-split (rotate_half) convention as HF; RMSNorm
+eps maps from hf_config.rms_norm_eps (Llama-2 ships 1e-5). Checkpoints
+carrying tensors with no slot here (biases, rope_scaling variants)
+fail the conversion loudly instead of converting into a numerically
+different model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Map a transformers LlamaConfig onto ours. Raises on HF features
+    this model doesn't implement (silent drops would convert cleanly
+    and generate subtly wrong logits)."""
+    import jax.numpy as jnp
+
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
+        None, "default",
+    ):
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not implemented; converting "
+            "anyway would mis-position every token (Llama-3.1+ "
+            "frequency scaling)"
+        )
+    return LlamaConfig(
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads",
+            hf_config.num_attention_heads,
+        ),
+        intermediate=hf_config.intermediate_size,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        max_seq_len=getattr(
+            hf_config, "max_position_embeddings", 4096
+        ),
+        dtype=jnp.float32,
+        attention="reference",
+        remat=False,
+    )
+
+
+def _np(tensor) -> np.ndarray:
+    return np.asarray(tensor.detach().cpu().numpy(), dtype=np.float32)
+
+
+def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
+    """HF LlamaForCausalLM state dict -> our params pytree (layers
+    stacked on axis 0 for lax.scan)."""
+    import jax.numpy as jnp
+
+    L = cfg.n_layers
+    consumed = set()
+
+    def layer_key(i: int, name: str) -> str:
+        return f"model.layers.{i}.{name}"
+
+    def stack(name: str, transpose: bool = True):
+        mats = []
+        for i in range(L):
+            key = layer_key(i, name)
+            consumed.add(key)
+            w = _np(state_dict[key])
+            mats.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(mats), dtype=cfg.dtype)
+
+    layers = {
+        "wq": stack("self_attn.q_proj.weight"),
+        "wk": stack("self_attn.k_proj.weight"),
+        "wv": stack("self_attn.v_proj.weight"),
+        "wo": stack("self_attn.o_proj.weight"),
+        # Our swiglu(x, gate) gates its SECOND argument; the forward
+        # computes swiglu(h @ w1, h @ w3), so gate_proj lands in w3.
+        "w3": stack("mlp.gate_proj.weight"),
+        "w1": stack("mlp.up_proj.weight"),
+        "w2": stack("mlp.down_proj.weight"),
+        "attn_norm": stack("input_layernorm.weight", transpose=False),
+        "mlp_norm": stack(
+            "post_attention_layernorm.weight", transpose=False
+        ),
+    }
+    embed = _np(state_dict["model.embed_tokens.weight"])
+    consumed.add("model.embed_tokens.weight")
+    if "lm_head.weight" in state_dict:
+        lm_head = _np(state_dict["lm_head.weight"]).T
+        consumed.add("lm_head.weight")
+    else:  # tied embeddings
+        lm_head = embed.T
+    consumed.add("model.norm.weight")
+    # Every weight must be accounted for: a checkpoint with tensors we
+    # don't map (attention/MLP biases, adapters) would otherwise
+    # convert silently into a numerically different model.
+    leftover = [
+        k for k in state_dict
+        if k not in consumed
+        and not k.endswith("rotary_emb.inv_freq")  # derived buffer
+    ]
+    if leftover:
+        raise ValueError(
+            f"unconverted checkpoint tensors {leftover[:8]}"
+            f"{'...' if len(leftover) > 8 else ''} — this model has no "
+            "slot for them (e.g. attention_bias=True is unsupported)"
+        )
+    return {
+        "embed": jnp.asarray(embed, dtype=cfg.dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(
+            _np(state_dict["model.norm.weight"]), dtype=cfg.dtype
+        ),
+        "lm_head": jnp.asarray(lm_head, dtype=cfg.dtype),
+    }
+
+
+def load_hf_llama(model) -> Tuple[Dict[str, Any], LlamaConfig]:
+    """From a live transformers LlamaForCausalLM (or a local path
+    loadable by from_pretrained — this hermetic environment has no
+    model hub access, so paths must be local)."""
+    if isinstance(model, str):
+        from transformers import LlamaForCausalLM
+
+        model = LlamaForCausalLM.from_pretrained(model)
+    cfg = config_from_hf(model.config)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    return params, cfg

@@ -59,6 +59,8 @@ class LlamaConfig:
     moe_capacity_factor: float = 2.0
     #: Weight of the Switch/GShard load-balancing auxiliary loss.
     moe_aux_weight: float = 0.01
+    #: RMSNorm epsilon (HF rms_norm_eps; Llama-2 ships 1e-5).
+    norm_eps: float = 1e-6
 
     @property
     def head_dim(self) -> int:
@@ -212,7 +214,7 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
     aux is the MoE load-balancing loss (0 for dense layers)."""
     b, t, _ = x.shape
     hd = cfg.head_dim
-    h = rms_norm(x, layer["attn_norm"])
+    h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
     q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
     k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
     v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
@@ -221,7 +223,7 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
     attn = _attention(cfg, q, k, v, sp_axis)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
     x = x + attn @ layer["wo"]
-    h = rms_norm(x, layer["mlp_norm"])
+    h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
     if cfg.moe_experts:
         moe_params = {
             "router": layer["router"],
@@ -278,7 +280,7 @@ def forward_and_aux(
         else:
             body = jax.checkpoint(body)
     x, auxs = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"])
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, jnp.sum(auxs)
 

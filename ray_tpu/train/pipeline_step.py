@@ -176,7 +176,7 @@ def make_pp_train_step(
         aux = lax.psum(aux_local, "pp") / num_mb
         outs = broadcast_from_last_stage(outs, "pp")
         h = outs.reshape(b_loc, t_loc, -1)
-        h = rms_norm(h, params["final_norm"])
+        h = rms_norm(h, params["final_norm"], eps=cfg.norm_eps)
         logits = (h @ params["lm_head"]).astype(jnp.float32)
 
         nll_sum, count = masked_xent(logits, targets)
