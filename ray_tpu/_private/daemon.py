@@ -103,6 +103,8 @@ class WorkerInfo:
     worker_id: WorkerID
     pid: int
     idle: bool = True
+    #: Wall time of the last busy->idle transition (drives idle reap).
+    idle_since: float = field(default_factory=time.time)
     is_tpu: bool = False
     pinned_actor: Optional[ActorID] = None
     current_task: Optional[TaskID] = None
@@ -331,10 +333,11 @@ class NodeDaemon:
             "task_event",
             # object spilling (all nodes)
             "spill_request",
-            # log streaming (subscribe on any node; batch fwd to head)
+            # pubsub (subscribe on any node; events forward to head)
             "subscribe_logs",
             "unsubscribe_logs",
             "log_batch",
+            "publish_event",
             # head fault tolerance
             "node_resync",
         ]:
@@ -560,7 +563,12 @@ class NodeDaemon:
             self._retry_pending_pgs()
         if any_parked:
             self._retry_infeasible()
-        return {"ok": True, "logs_wanted": bool(self._log_subscribers)}
+        with self._lock:
+            logs_wanted = any(
+                "log_lines" in chans
+                for _, chans in self._log_subscribers.values()
+            )
+        return {"ok": True, "logs_wanted": logs_wanted}
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown:
@@ -772,6 +780,7 @@ class NodeDaemon:
                 worker.leased_by = None
                 worker.current_task = None
                 worker.idle = True
+                worker.idle_since = time.time()
         self.scheduler.release(lease_id)
         self._schedule()
 
@@ -1285,10 +1294,15 @@ class NodeDaemon:
     # log files, publish line batches; driver prints with prefixes)
     # ------------------------------------------------------------------
     def _on_head_push(self, channel: str, msg: dict) -> None:
-        """Pushes arriving on the node->head client connection — today
-        only relayed log batches for this node's local drivers."""
-        if channel == "log_lines":
-            self._push_logs(msg.get("batches", []), msg.get("node", ""))
+        """Pushes arriving on the node->head client connection: relayed
+        pubsub events (log batches, error events, future channels) for
+        this node's local subscribers."""
+        if channel:
+            msg = {
+                k: v for k, v in msg.items()
+                if k not in ("_mid", "_push")
+            }
+            self._push_to_subscribers(channel, msg)
 
     def _on_head_reconnect(self) -> None:
         """Per-connection head state must be re-established after a
@@ -1299,21 +1313,31 @@ class NodeDaemon:
             self._ensure_log_relay()
 
     def _h_subscribe_logs(self, conn, msg):
-        """Subscribe this connection to streamed worker logs. The conn
-        may be a local driver OR (on the head) a worker-node daemon
-        relaying for its own local drivers."""
+        """Subscribe this connection to pushed pubsub channels
+        ("log_lines" worker output, "error_event" cluster failures).
+        The conn may be a local driver OR (on the head) a worker-node
+        daemon relaying for its own local drivers."""
+        channels = set(msg.get("channels") or ("log_lines",))
         with self._lock:
-            self._log_subscribers[conn.conn_id] = conn
+            prev = self._log_subscribers.get(conn.conn_id)
+            if prev is not None:
+                channels |= prev[1]
+            self._log_subscribers[conn.conn_id] = (conn, channels)
         if not self.is_head and self.head is not None:
-            # Relay: all batches flow through the head (every node
+            # Relay: all events flow through the head (every node
             # forwards there), so a driver attached to a non-head node
-            # sees cluster-wide logs by this node subscribing upstream.
+            # sees cluster-wide traffic by this node subscribing
+            # upstream for the union of its local channels.
             self._ensure_log_relay()
         return {}
 
     def _ensure_log_relay(self) -> None:
+        with self._lock:
+            union = set()
+            for _, chans in self._log_subscribers.values():
+                union |= chans
         try:
-            self.head.notify("subscribe_logs")
+            self.head.notify("subscribe_logs", channels=sorted(union))
         except Exception:
             pass
 
@@ -1345,6 +1369,28 @@ class NodeDaemon:
         self._push_logs(msg["batches"], msg.get("node", ""))
         return {}
 
+    def _h_publish_event(self, conn, msg):
+        """A worker node forwards a pubsub event for head fan-out."""
+        self._push_to_subscribers(msg["channel"], msg["payload"])
+        return {}
+
+    def _push_to_subscribers(self, channel: str, payload: dict) -> None:
+        """Fan one event out to every subscriber of `channel` (shared
+        by log batches and error events; pop subscribers whose
+        connection died)."""
+        with self._lock:
+            subs = [
+                (cid, conn)
+                for cid, (conn, chans) in self._log_subscribers.items()
+                if channel in chans
+            ]
+        for conn_id, conn in subs:
+            try:
+                conn.push(channel, payload)
+            except Exception:
+                with self._lock:
+                    self._log_subscribers.pop(conn_id, None)
+
     def _push_logs(self, batches: list, node: str) -> None:
         # Known limitation vs the reference's per-job log_monitor
         # filtering: workers here are shared across jobs, so a stdout
@@ -1352,18 +1398,18 @@ class NodeDaemon:
         # every line (prefixed by worker/pid/node). Multi-driver
         # sessions wanting isolation set log_to_driver=False and read
         # session-dir files.
-        with self._lock:
-            subs = list(self._log_subscribers.items())
-        for conn_id, conn in subs:
-            try:
-                conn.push("log_lines", {"batches": batches, "node": node})
-            except Exception:
-                with self._lock:
-                    self._log_subscribers.pop(conn_id, None)
+        self._push_to_subscribers(
+            "log_lines", {"batches": batches, "node": node}
+        )
 
     def _logs_wanted(self) -> bool:
         """Whether anyone, anywhere, wants this node's log lines."""
-        if self._log_subscribers:
+        with self._lock:
+            local = any(
+                "log_lines" in chans
+                for _, chans in self._log_subscribers.values()
+            )
+        if local:
             return True
         # Worker nodes learn via the heartbeat reply whether the head
         # has subscribers (drivers or node relays).
@@ -1466,7 +1512,51 @@ class NodeDaemon:
                 self._maybe_spill()
             except Exception:
                 pass
+            try:
+                self._reap_idle_workers()
+            except Exception:
+                pass
             time.sleep(self.config.object_eviction_check_interval_s)
+
+    #: Idle workers beyond the pool cap live this long before exiting.
+    _IDLE_WORKER_GRACE_S = 5.0
+
+    def _reap_idle_workers(self) -> None:
+        """Shrink the warm pool back to worker_pool_max_idle_workers
+        (reference: WorkerPool TryKillingIdleWorkers,
+        worker_pool.cc — idle workers past the cap are asked to exit
+        after a grace period). Leased and actor-pinned workers never
+        count as idle."""
+        cap = self.config.worker_pool_max_idle_workers or max(
+            1, int(self.resources.get("CPU", 1))
+        )
+        now = time.time()
+        with self._lock:
+            idle = [
+                w for w in self.workers.values()
+                if w.idle
+                and w.pinned_actor is None
+                and w.leased_by is None
+            ]
+            excess = len(idle) - cap
+            if excess <= 0:
+                return
+            idle.sort(key=lambda w: w.idle_since)  # oldest first
+            victims = [
+                w for w in idle[:excess]
+                if now - w.idle_since > self._IDLE_WORKER_GRACE_S
+            ]
+            for w in victims:
+                # Unschedulable from the same critical section that
+                # selected it: a dispatch racing the exit push would
+                # otherwise land a task on a dying worker and surface
+                # a spurious WorkerCrashedError.
+                w.idle = False
+        for w in victims:
+            try:
+                w.conn.push("exit", {})
+            except Exception:
+                pass
 
     def _h_spill_request(self, conn, msg):
         """A local worker hit store-full on create: synchronously free
@@ -2256,9 +2346,31 @@ class NodeDaemon:
         with self._lock:
             if winfo is not None and winfo.pinned_actor is None:
                 winfo.idle = True
+                winfo.idle_since = time.time()
                 winfo.current_task = None
         self._schedule()
         return {}
+
+    def _publish_error_event(self, source: str, message: str) -> None:
+        """Push a cluster error event to subscribed drivers (reference:
+        error messages published per job and printed by the driver,
+        worker.py listen_error_messages). Rides the same subscriber
+        registry as log streaming — one pubsub, several channels.
+        Worker-node failures forward through the head like everything
+        else (drivers attach there)."""
+        payload = {
+            "source": source, "message": message, "time": time.time(),
+        }
+        if self.is_head:
+            self._push_to_subscribers("error_event", payload)
+        elif self.head is not None:
+            try:
+                self.head.notify(
+                    "publish_event", channel="error_event",
+                    payload=payload,
+                )
+            except Exception:
+                pass
 
     def _fail_task_returns(self, spec: dict, kind: str, detail: str) -> None:
         from .task_spec import make_error_payload
@@ -2267,6 +2379,10 @@ class NodeDaemon:
         for ret in spec["returns"]:
             self._seal_error(ObjectID(ret), payload)
         self._record_task_event(spec, "FAILED")
+        self._publish_error_event(
+            f"task {spec.get('name') or TaskID(spec['task_id']).hex()[:8]}",
+            f"{kind}: {detail}",
+        )
         if not self.is_head:
             return
         with self._lock:
@@ -2514,7 +2630,18 @@ class NodeDaemon:
             runtime = self.actor_runtimes.get(actor_id)
             if runtime is None:
                 return
+            already_dead = runtime.info.state == ACTOR_DEAD
             runtime.info.state = ACTOR_DEAD
+        if not already_dead:
+            # Publish exactly once, on the live->dead transition (kill
+            # + later worker-death report would double-announce).
+            self._publish_error_event(
+                f"actor {actor_id.hex()[:8]}", f"dead: {cause}"
+            )
+        with self._lock:
+            runtime = self.actor_runtimes.get(actor_id)
+            if runtime is None:
+                return
             pending = list(runtime.pending)
             runtime.pending.clear()
             inflight = list(runtime.inflight.values())

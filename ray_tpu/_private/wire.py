@@ -194,10 +194,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "cluster_load": {},
     "metrics_record": {"records": list},
     "metrics_summary": {},
-    # log streaming
-    "subscribe_logs": {},
+    # pubsub / log streaming
+    "subscribe_logs": {"?channels": list},
     "unsubscribe_logs": {},
     "log_batch": {"batches": list, "node": str},
+    "publish_event": {"channel": str, "payload": dict},
 }
 
 

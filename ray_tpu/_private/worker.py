@@ -177,15 +177,23 @@ class CoreWorker:
             from .direct import DirectTaskManager
 
             self._direct = DirectTaskManager(self)
-        if role == "driver" and self.config.log_to_driver:
-            # Worker stdout/stderr streams to this process (reference:
-            # log_monitor.py subscription on driver startup). The
-            # subscription is per-connection daemon state, so it must
-            # be re-sent after any transparent RPC reconnect.
-            self._client.notify("subscribe_logs")
-            self._client.set_on_reconnect(
-                lambda: self._client.notify("subscribe_logs")
-            )
+        if role == "driver":
+            # Error events always flow (reference: published error
+            # messages print regardless of log streaming); worker
+            # stdout/stderr only with log_to_driver. The subscription
+            # is per-connection daemon state, so it must be re-sent
+            # after any transparent RPC reconnect.
+            channels = ["error_event"]
+            if self.config.log_to_driver:
+                channels.append("log_lines")
+
+            def _subscribe():
+                self._client.notify(
+                    "subscribe_logs", channels=channels
+                )
+
+            _subscribe()
+            self._client.set_on_reconnect(_subscribe)
 
     def _notify_store_evict(self, oid: ObjectID) -> None:
         """Arena evictions can originate in any process; tell the node
@@ -850,6 +858,14 @@ class CoreWorker:
             self._task_queue.put((msg["spec"], None))
         elif channel == "log_lines":
             self._print_worker_logs(msg)
+        elif channel == "error_event":
+            # Cluster error surfaced even when no get() will raise it
+            # (reference: driver prints published error messages).
+            print(
+                f"[ray_tpu] ({msg.get('source', '?')}) "
+                f"{msg.get('message', '')}",
+                file=sys.stderr,
+            )
         elif channel == "exit":
             self._running = False
             self._task_queue.put(None)

@@ -128,11 +128,52 @@ def test_log_to_driver_off_is_quiet():
 
         assert rt.get(quiet.remote()) == 1
         time.sleep(1.0)
-        worker = __import__(
-            "ray_tpu._private.worker", fromlist=["global_worker"]
-        ).global_worker()
-        # The daemon-side monitor never started; nothing subscribed.
+        # No log_lines subscription (error_event alone doesn't drive
+        # the tail loop).
         daemon = rt.api._session.daemon
-        assert not daemon._log_subscribers
+        assert not daemon._logs_wanted()
     finally:
         rt.shutdown()
+
+
+def test_error_events_pushed_to_driver(cluster, capfd):
+    """Failures a driver might never get() still surface as pushed
+    error events (reference: published error messages printed by the
+    driver)."""
+
+    @rt.remote(max_restarts=0)
+    class Dies:
+        def boom(self):
+            import os
+
+            os._exit(1)
+
+    d = Dies.remote()
+    ref = d.boom.remote()  # fire and forget — never get()
+    _wait_for(capfd, "actor ")
+
+
+def test_error_event_from_remote_node_reaches_driver(capfd):
+    """A failure detected on a WORKER node forwards through the head
+    to the driver (publish_event relay)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 1.0})
+    rt.init(address=c.address)
+    try:
+        c.add_node(num_cpus=1, resources={"special": 1.0})
+        c.wait_for_nodes(2)
+
+        @rt.remote(resources={"special": 1.0}, max_restarts=0)
+        class RemoteDies:
+            def boom(self):
+                import os
+
+                os._exit(1)
+
+        d = RemoteDies.remote()
+        d.boom.remote()  # never get()
+        _wait_for(capfd, "dead:")
+    finally:
+        rt.shutdown()
+        c.shutdown()

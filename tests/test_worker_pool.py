@@ -1,0 +1,78 @@
+"""Worker pool lifecycle: prestart warms the first task, idle reaping
+shrinks a burst-inflated pool back to the cap (reference:
+worker_pool.cc PrestartWorkers / TryKillingIdleWorkers)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+def test_burst_pool_shrinks_to_idle_cap():
+    rt.init(
+        num_cpus=8,
+        _system_config={
+            "worker_pool_max_idle_workers": 2,
+            "object_eviction_check_interval_s": 0.2,
+        },
+    )
+    try:
+        daemon = rt.api._session.daemon
+        # Shorten the grace so the test doesn't idle for 5s.
+        daemon._IDLE_WORKER_GRACE_S = 0.5
+
+        @rt.remote
+        def burst(i):
+            time.sleep(0.2)
+            return i
+
+        # Saturate: forces ~8 concurrent workers.
+        assert sorted(
+            rt.get([burst.remote(i) for i in range(16)], timeout=60)
+        ) == list(range(16))
+        peak = len(daemon.workers)
+        assert peak >= 4, f"burst should have inflated the pool ({peak})"
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if len(daemon.workers) <= 2:
+                break
+            time.sleep(0.2)
+        assert len(daemon.workers) <= 2, (
+            f"idle pool must shrink to the cap, still {len(daemon.workers)}"
+        )
+
+        # The shrunken pool still serves work.
+        assert rt.get(burst.remote(99), timeout=30) == 99
+    finally:
+        rt.shutdown()
+
+
+def test_actor_pinned_workers_never_reaped():
+    rt.init(
+        num_cpus=4,
+        _system_config={
+            "worker_pool_max_idle_workers": 1,
+            "object_eviction_check_interval_s": 0.2,
+        },
+    )
+    try:
+        daemon = rt.api._session.daemon
+        daemon._IDLE_WORKER_GRACE_S = 0.3
+
+        @rt.remote
+        class Keeper:
+            def ping(self):
+                return "alive"
+
+        keepers = [Keeper.remote() for _ in range(3)]
+        assert rt.get(
+            [k.ping.remote() for k in keepers], timeout=30
+        ) == ["alive"] * 3
+        time.sleep(2.0)  # several reap cycles
+        assert rt.get(
+            [k.ping.remote() for k in keepers], timeout=30
+        ) == ["alive"] * 3
+    finally:
+        rt.shutdown()
