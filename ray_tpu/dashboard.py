@@ -20,28 +20,104 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+#: Self-contained SPA (no build step, no external assets — the
+#: reference ships a React bundle; this serves the same state API from
+#: one static page with fetch polling).
 _PAGE = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<meta http-equiv="refresh" content="2">
-<style>body{font-family:monospace;margin:2em}table{border-collapse:
-collapse}td,th{border:1px solid #999;padding:4px 8px;text-align:left}
-h2{margin-top:1.5em}</style></head>
-<body><h1>ray_tpu cluster</h1><div id="content">%CONTENT%</div>
-</body></html>"""
+<html><head><title>ray_tpu dashboard</title><meta charset="utf-8">
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2430}
+ header{background:#1c2430;color:#fff;padding:10px 20px;display:flex;
+   align-items:baseline;gap:16px}
+ header h1{font-size:18px;margin:0}
+ header span{color:#9fb0c3;font-size:12px}
+ nav{display:flex;gap:4px;padding:8px 16px;background:#fff;
+   border-bottom:1px solid #dde3ea}
+ nav button{border:0;background:none;padding:6px 12px;cursor:pointer;
+   border-radius:6px;font-size:13px;color:#44506a}
+ nav button.active{background:#e8eefc;color:#1a48c4;font-weight:600}
+ main{padding:16px 20px}
+ .cards{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px}
+ .card{background:#fff;border:1px solid #dde3ea;border-radius:8px;
+   padding:10px 16px;min-width:110px}
+ .card .v{font-size:22px;font-weight:700}
+ .card .k{font-size:11px;color:#7a8699;text-transform:uppercase}
+ table{border-collapse:collapse;background:#fff;width:100%;font-size:13px}
+ td,th{border:1px solid #e3e8ef;padding:6px 10px;text-align:left}
+ th{background:#eef1f6;font-weight:600}
+ tr:nth-child(even) td{background:#fafbfd}
+ #err{color:#b00020;font-size:12px}
+ code{font-size:12px}
+</style></head><body>
+<header><h1>ray_tpu</h1><span id="addr"></span><span id="err"></span></header>
+<nav id="tabs"></nav><main>
+ <div class="cards" id="cards"></div>
+ <div id="view"></div>
+</main>
+<script>
+const TABS = ["nodes","actors","tasks","objects","placement_groups",
+              "resources","metrics"];
+let active = "nodes";
+const $ = (id) => document.getElementById(id);
+function tabs() {
+  $("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t===active?"active":""}"
+       onclick="active='${t}';tabs();tick()">${t.replace("_"," ")}</button>`
+  ).join("");
+}
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+function table(rows) {
+  if (!Array.isArray(rows)) rows = rows ? [rows] : [];
+  if (!rows.length) return "<i>none</i>";
+  // Union of keys: rows can be heterogeneous (metrics kinds).
+  const keys = [...new Set(rows.flatMap(r => Object.keys(r)))];
+  const fmt = v => v === undefined ? ""
+    : typeof v === "object" && v !== null
+      ? `<code>${esc(JSON.stringify(v))}</code>` : esc(v);
+  return "<table><tr>" + keys.map(k=>`<th>${esc(k)}</th>`).join("") +
+    "</tr>" + rows.map(r => "<tr>" +
+      keys.map(k=>`<td>${fmt(r[k])}</td>`).join("") + "</tr>").join("") +
+    "</table>";
+}
+async function j(path) {
+  const resp = await fetch(path);
+  if (!resp.ok) throw new Error(path + " -> HTTP " + resp.status);
+  return resp.json();
+}
+async function tick() {
+  const tab = active;  // discard stale responses after a tab switch
+  try {
+    const s = await j("/api/summary");
+    const sum = s.summary || s;
+    $("cards").innerHTML = [
+      ["nodes", sum.alive_nodes], ["actors", sum.actors],
+      ["workers", sum.workers], ["queued", sum.queued_tasks],
+      ["objects", sum.num_objects],
+      ["store", ((sum.used||0)/1048576).toFixed(1)+" / "+
+                ((sum.capacity||0)/1048576).toFixed(0)+" MB"],
+      ["spilled", ((sum.spilled_bytes||0)/1048576).toFixed(1)+" MB"],
+    ].map(([k,v]) =>
+      `<div class="card"><div class="v">${esc(v ?? 0)}</div>
+       <div class="k">${esc(k)}</div></div>`).join("");
+    const data = await j("/api/" + tab);
+    if (tab !== active) return;
+    $("view").innerHTML = table(
+      tab === "resources" || tab === "metrics"
+        ? Object.entries(data).map(([k,v]) => ({name:k, ...(
+            typeof v === "object" ? v : {value:v})}))
+        : data);
+    $("err").textContent = "";
+  } catch (e) { $("err").textContent = "fetch failed: " + e; }
+}
+$("addr").textContent = location.host;
+tabs(); tick(); setInterval(tick, 2000);
+</script></body></html>"""
 
 
-def _render_table(rows) -> str:
-    if not rows:
-        return "<i>none</i>"
-    keys = list(rows[0].keys())
-    head = "".join(f"<th>{k}</th>" for k in keys)
-    body = "".join(
-        "<tr>"
-        + "".join(f"<td>{row.get(k, '')}</td>" for k in keys)
-        + "</tr>"
-        for row in rows
-    )
-    return f"<table><tr>{head}</tr>{body}</table>"
+_UNKNOWN_API = object()
 
 
 def _prometheus_text(metrics: dict) -> str:
@@ -99,7 +175,7 @@ class Dashboard:
         import ray_tpu
 
         state = self._state
-        return {
+        handlers = {
             "summary": lambda: ray_tpu.state_summary(),
             "nodes": state.list_nodes,
             "actors": state.list_actors,
@@ -111,7 +187,13 @@ class Dashboard:
                 "available": ray_tpu.available_resources(),
             },
             "metrics": self._metrics,
-        }[kind]()
+        }
+        fn = handlers.get(kind)
+        if fn is None:
+            # Sentinel, not an exception: a KeyError raised INSIDE a
+            # handler must stay a 500, not read as "no such api".
+            return _UNKNOWN_API
+        return fn()
 
     @staticmethod
     def _metrics():
@@ -123,6 +205,12 @@ class Dashboard:
         if path.startswith("/api/"):
             kind = path[len("/api/") :].strip("/")
             data = self._collect(kind)
+            if data is _UNKNOWN_API:
+                return (
+                    404,
+                    json.dumps({"error": f"no such api: {kind}"}).encode(),
+                    "application/json",
+                )
             return (
                 200,
                 json.dumps(data, default=str).encode(),
@@ -135,29 +223,7 @@ class Dashboard:
                 "text/plain; version=0.0.4",
             )
         if path in ("/", "/index.html"):
-            import ray_tpu
-
-            sections = [
-                "<h2>summary</h2>"
-                + _render_table([ray_tpu.state_summary()]),
-                "<h2>resources</h2>"
-                + _render_table(
-                    [
-                        {
-                            "total": ray_tpu.cluster_resources(),
-                            "available": ray_tpu.available_resources(),
-                        }
-                    ]
-                ),
-                "<h2>nodes</h2>"
-                + _render_table(self._state.list_nodes()),
-                "<h2>actors</h2>"
-                + _render_table(self._state.list_actors()),
-                "<h2>placement groups</h2>"
-                + _render_table(self._state.list_placement_groups()),
-            ]
-            page = _PAGE.replace("%CONTENT%", "".join(sections))
-            return 200, page.encode(), "text/html"
+            return 200, _PAGE.encode(), "text/html"
         return (
             404,
             json.dumps({"error": "not found"}).encode(),

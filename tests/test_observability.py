@@ -119,7 +119,9 @@ def test_dashboard_endpoints(rt_session):
         resources = json.loads(fetch("/api/resources"))
         assert "CPU" in resources["total"]
         html = fetch("/").decode()
-        assert "ray_tpu cluster" in html and "Marker" in html
+        # SPA shell: data is client-rendered from /api/* (asserted
+        # above); the page just needs to serve with its poller.
+        assert "ray_tpu" in html and "/api/" in html
 
         from ray_tpu.util.metrics import Counter, flush
 
