@@ -134,3 +134,37 @@ def test_flash_attention_matches_hf_reference():
         forward(params, jax.numpy.asarray(tokens), cfg)
     )
     assert np.max(np.abs(ours - ref)) < 2e-3
+
+
+def test_greedy_decode_matches_transformers_generate():
+    """Greedy decode through OUR KV-cache prefill+step loop produces
+    the same continuation transformers.generate does — pins the cache
+    write indices, rotary offsets, and last-position logit selection of
+    the serving path, not just the training forward."""
+    from ray_tpu.models.generate import generate
+
+    model = _tiny_hf_llama(n_heads=4, n_kv_heads=4, seed=5)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, 128, (2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt),
+            max_new_tokens=10,
+            do_sample=False,
+            pad_token_id=0,
+            # Ours runs the full budget (eos_token=-1 default); HF
+            # must not stop early at its default eos_token_id=2, or a
+            # lucky token-2 emission zero-pads only one side.
+            eos_token_id=None,
+        )[:, prompt.shape[1]:].numpy()
+    cfg = config_from_hf(model.config)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    ours, lengths = generate(
+        params,
+        jax.numpy.asarray(prompt),
+        jax.numpy.asarray(np.full(2, prompt.shape[1], np.int32)),
+        cfg,
+        max_new_tokens=10,
+        temperature=0.0,
+    )
+    assert np.asarray(ours).tolist() == ref.tolist()
