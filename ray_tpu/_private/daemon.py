@@ -226,6 +226,9 @@ class NodeDaemon:
         # Worker-node cache of "does the head have log subscribers",
         # piggybacked on heartbeat replies.
         self._head_logs_wanted = False
+        # Head-side resource-sync versions: node_id -> last version
+        # whose load snapshot was applied (versioned delta heartbeats).
+        self._node_sync_versions: Dict[bytes, int] = {}
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -518,6 +521,11 @@ class NodeDaemon:
     def _h_register_node(self, conn, msg):
         """A worker-node daemon joins the cluster (head only)."""
         node_id = NodeID(msg["node_id"])
+        with self._lock:
+            # (Re-)registration resets NodeInfo.available to totals —
+            # any previously acked load snapshot no longer describes
+            # what this table holds, so force the node to resend.
+            self._node_sync_versions.pop(node_id.binary(), None)
         self.control.register_node(
             NodeInfo(
                 node_id=node_id,
@@ -543,13 +551,23 @@ class NodeDaemon:
             return {"ok": False, "unknown_node": True}
         info.last_heartbeat = time.time()
         info.alive = True  # a heartbeating node is alive
-        info.available = dict(msg.get("available") or {})
-        info.queued = int(msg.get("queued", 0))
-        # Totals change when placement-group bundles commit/release
-        # (group resources are added to the node pool).
-        total = msg.get("total")
-        if total is not None:
-            info.resources = dict(total)
+        version = int(msg.get("version", 0))
+        if "available" in msg:
+            # Payload present: apply + ack this version. Liveness-only
+            # beats (unchanged state) leave the last snapshot in place.
+            info.available = dict(msg.get("available") or {})
+            info.queued = int(msg.get("queued", 0))
+            # Totals change when placement-group bundles commit/release
+            # (group resources are added to the node pool).
+            total = msg.get("total")
+            if total is not None:
+                info.resources = dict(total)
+            with self._lock:
+                self._node_sync_versions[msg["node_id"]] = version
+            acked = version
+        else:
+            with self._lock:
+                acked = self._node_sync_versions.get(msg["node_id"], -1)
         # Parked tasks (forward raced a node death, or no feasible node
         # yet) and pending placement groups get another placement
         # attempt on the heartbeat tick.
@@ -568,21 +586,46 @@ class NodeDaemon:
                 "log_lines" in chans
                 for _, chans in self._log_subscribers.values()
             )
-        return {"ok": True, "logs_wanted": logs_wanted}
+        return {
+            "ok": True,
+            "logs_wanted": logs_wanted,
+            "acked_version": acked,
+        }
 
     def _heartbeat_loop(self) -> None:
+        # Versioned resource sync (reference: ray_syncer's versioned
+        # resource messages, common/ray_syncer): the load snapshot only
+        # rides the heartbeat when it CHANGED since the head's last
+        # ack — an idle 1000-node cluster heartbeats liveness-only.
+        version = 0
+        last_acked = -1
+        last_state = None
         while not self._shutdown:
             try:
-                reply = self.head.call(
-                    "node_heartbeat",
-                    node_id=self.node_id.binary(),
-                    available=self.scheduler.available().to_dict(),
-                    total=self.scheduler.total().to_dict(),
-                    queued=self.scheduler.queued_count(),
-                    timeout=10.0,
+                state = (
+                    self.scheduler.available().to_dict(),
+                    self.scheduler.total().to_dict(),
+                    self.scheduler.queued_count(),
                 )
+                if state != last_state:
+                    version += 1
+                    last_state = state
+                kwargs = {
+                    "node_id": self.node_id.binary(),
+                    "version": version,
+                    "timeout": 10.0,
+                }
+                if version != last_acked:
+                    kwargs.update(
+                        available=state[0], total=state[1],
+                        queued=state[2],
+                    )
+                reply = self.head.call("node_heartbeat", **kwargs)
+                if reply.get("acked_version") == version:
+                    last_acked = version
                 self._head_logs_wanted = bool(reply.get("logs_wanted"))
                 if reply.get("unknown_node"):
+                    last_acked = -1  # full snapshot after re-register
                     self._resync_with_head()
             except Exception:
                 if self._shutdown:
@@ -590,7 +633,10 @@ class NodeDaemon:
                 # Head connection lost — likely a head restart
                 # (reference: raylet resync on HandleNotifyGCSRestart,
                 # node_manager.cc:1189). Re-register and re-report our
-                # live actors + sealed objects once it is back.
+                # live actors + sealed objects once it is back. The
+                # (possibly new) head's view of our load is unknown,
+                # so the next beat must carry the full snapshot.
+                last_acked = -1
                 try:
                     self._resync_with_head()
                 except Exception:
@@ -3129,6 +3175,8 @@ class NodeDaemon:
         if self._shutdown:
             return
         self.control.mark_node_dead(NodeID(node_id))
+        with self._lock:
+            self._node_sync_versions.pop(node_id, None)
         self._pg_on_node_death(node_id)
         with self._lock:
             client = self._node_clients.pop(node_id, None)

@@ -111,7 +111,8 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?labels": (dict, type(None)),
     },
     "node_heartbeat": {
-        "node_id": bytes, "?available": (dict, type(None)),
+        "node_id": bytes, "?version": int,
+        "?available": (dict, type(None)),
         "?total": (dict, type(None)), "?queued": int,
     },
     "node_resync": {"node_id": bytes, "actors": list, "objects": list},

@@ -310,3 +310,54 @@ def test_nested_task_submission_from_remote_node(rt_cluster):
         return sum(rt2.get(refs, timeout=30))
 
     assert rt.get(outer.remote(), timeout=60) == 12
+
+
+def test_versioned_heartbeats_elide_unchanged_load(rt_cluster):
+    """Resource snapshots ride heartbeats only when they CHANGED since
+    the head's last ack (reference: ray_syncer versioned resource
+    messages) — idle nodes beat liveness-only."""
+    rt, cluster = rt_cluster
+    node = cluster.add_node(num_cpus=1, resources={"special": 1.0})
+    cluster.wait_for_nodes(2)
+
+    head = cluster.head
+    seen = []
+    orig = head._h_node_heartbeat
+
+    def spy(conn, msg):
+        if msg.get("node_id") == node.node_id.binary():
+            seen.append("available" in msg)
+        return orig(conn, msg)
+
+    head.server._handlers["node_heartbeat"] = spy
+    try:
+        time.sleep(1.5)  # ~6 idle beats
+        idle = list(seen)
+        assert len(idle) >= 3
+        # After the initial (changed) beat, payloads stop.
+        assert not any(idle[1:]), idle
+
+        seen.clear()
+
+        @rt.remote(resources={"special": 1.0})
+        def touch():
+            time.sleep(0.8)  # hold the resource across several beats
+            return 1
+
+        assert rt.get(touch.remote(), timeout=30) == 1
+        # Running a task changed availability -> payload reappears.
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(seen):
+            time.sleep(0.1)
+        assert any(seen), seen
+        # Head's view converges back to fully available once the
+        # lease returns (idle lease timeout ~1s).
+        deadline = time.time() + 10
+        info = head.control.nodes[node.node_id]
+        while time.time() < deadline:
+            if info.available.get("special") == 1.0:
+                break
+            time.sleep(0.1)
+        assert info.available.get("special") == 1.0
+    finally:
+        head.server._handlers["node_heartbeat"] = orig
