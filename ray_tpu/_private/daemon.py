@@ -229,6 +229,9 @@ class NodeDaemon:
         # Head-side resource-sync versions: node_id -> last version
         # whose load snapshot was applied (versioned delta heartbeats).
         self._node_sync_versions: Dict[bytes, int] = {}
+        # Finished tracing spans (head only; own ring so span-heavy
+        # apps and task-event-heavy apps can't evict each other).
+        self._spans: deque = deque(maxlen=config.task_events_max_buffer)
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -334,6 +337,9 @@ class NodeDaemon:
             "release_lease",
             "actor_address",
             "task_event",
+            # tracing spans (all nodes forward to the head's ring)
+            "span_event",
+            "list_spans",
             # object spilling (all nodes)
             "spill_request",
             # pubsub (subscribe on any node; events forward to head)
@@ -3744,6 +3750,25 @@ class NodeDaemon:
         for event in msg["events"]:
             self.control.add_task_event(event)
         return {}
+
+    def _h_span_event(self, conn, msg):
+        """Finished tracing spans (util/tracing.span) land in their
+        own ring — separate from task events so neither stream can
+        evict the other."""
+        if not self.is_head:
+            try:
+                self.head.notify("span_event", spans=msg["spans"])
+            except RpcError:
+                pass
+            return {}
+        with self._lock:
+            self._spans.extend(msg["spans"])
+        return {}
+
+    def _h_list_spans(self, conn, msg):
+        limit = int(msg.get("limit", 1000))
+        with self._lock:
+            return {"spans": list(self._spans)[-limit:]}
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if not self.config.task_events_enabled:

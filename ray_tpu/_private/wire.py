@@ -159,6 +159,8 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "cancel_task": {"task_id": bytes},
     "cancel_local": {"task_id": bytes},
     "task_event": {"events": list},
+    "span_event": {"spans": list},
+    "list_spans": {"?limit": int},
     # actors
     "create_actor": {"spec": dict},
     "submit_actor_task": {"spec": dict},

@@ -58,6 +58,17 @@ def _split_kwargs(flat):
     return list(flat), {}
 
 
+def _trace_ctx() -> Optional[dict]:
+    """Current span context for remote propagation (reference: ray's
+    OTel integration injects the span context into task metadata)."""
+    from ..util.tracing import current_span_context
+
+    ctx = current_span_context()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
 def global_worker() -> Optional["CoreWorker"]:
     return _global_worker
 
@@ -733,6 +744,7 @@ class CoreWorker:
             "task_id": task_id.binary(),
             "job_id": self.job_id.binary(),
             "kind": "normal",
+            "trace_ctx": _trace_ctx(),
             "name": name,
             "function_key": func_key,
             "args": self._serialize_args(args),
@@ -773,6 +785,7 @@ class CoreWorker:
             "task_id": task_id.binary(),
             "job_id": self.job_id.binary(),
             "kind": "actor_creation",
+            "trace_ctx": _trace_ctx(),
             "name": name,
             "namespace": namespace,
             "class_name": class_name,
@@ -809,6 +822,7 @@ class CoreWorker:
             "task_id": task_id.binary(),
             "job_id": self.job_id.binary(),
             "kind": "actor_task",
+            "trace_ctx": _trace_ctx(),
             "name": method,
             "method": method,
             "function_key": "",
@@ -977,9 +991,25 @@ class CoreWorker:
             self._actor_pg_context if spec["kind"] == "actor_task" else None
         )
         self.job_id = JobID(spec["job_id"])
+        trace_stack = None
         try:
             from .runtime_env import apply_runtime_env
 
+            tctx = spec.get("trace_ctx")
+            if tctx:
+                # Execution span linked under the caller's span
+                # (reference: ray's OTel task execution spans).
+                import contextlib as _contextlib
+
+                from ..util.tracing import remote_parent
+                from ..util.tracing import span as _tspan
+
+                trace_stack = _contextlib.ExitStack()
+                trace_stack.enter_context(remote_parent(tctx))
+                trace_stack.enter_context(_tspan(
+                    "task:" + (spec.get("name") or "anonymous"),
+                    kind=spec.get("kind", "normal"),
+                ))
             args, kwargs = _split_kwargs(self._deserialize_args(spec["args"]))
             kind = spec["kind"]
             # Actors keep their runtime env for life (they pin this
@@ -1036,6 +1066,13 @@ class CoreWorker:
                     value = func(*args, **kwargs)
                     results = self._collect_returns(task_id, spec, value)
         except BaseException as e:  # noqa: BLE001 — any task failure
+            if trace_stack is not None:
+                # The stack closes exception-free in `finally` (the
+                # error was caught here), so the execution span must be
+                # marked failed explicitly.
+                from ..util.tracing import add_span_attributes
+
+                add_span_attributes(error=repr(e))
             payload = make_exception_payload(e)
             if reply_to is not None:
                 # Events before the reply: a state/timeline query
@@ -1051,6 +1088,8 @@ class CoreWorker:
                 )
             return
         finally:
+            if trace_stack is not None:
+                trace_stack.close()
             self._ctx.task_id = None
             self._ctx.pg_context = None
         if reply_to is not None:

@@ -64,3 +64,168 @@ def export_timeline(path: str) -> List[dict]:
     import ray_tpu
 
     return timeline_to_chrome_trace(ray_tpu.timeline(), path)
+
+
+# ---------------------------------------------------------------------
+# Distributed spans with OTLP-JSON export (reference: ray's OTel
+# integration, python/ray/util/tracing/ — spans around task submit and
+# execution with remote context propagation). Self-contained: the OTLP
+# wire shape is produced directly, no opentelemetry SDK needed, so any
+# OTLP/JSON-ingesting backend (collector file receiver, Tempo, Jaeger)
+# reads the export.
+# ---------------------------------------------------------------------
+
+import contextvars
+import os as _os
+import time as _time
+from contextlib import contextmanager
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_current_span", default=None
+)
+
+
+class SpanContext:
+    __slots__ = ("trace_id", "span_id", "attributes")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        #: Mutable: add_span_attributes() writes here until span exit.
+        self.attributes: dict = {}
+
+
+def add_span_attributes(**attributes) -> None:
+    """Attach attributes to the CURRENT span (exported at its exit).
+    No-op outside any span — callers never need to guard."""
+    ctx = _current_span.get()
+    if ctx is not None and hasattr(ctx, "attributes"):
+        ctx.attributes.update(
+            {str(k): str(v) for k, v in attributes.items()}
+        )
+
+
+def current_span_context() -> "SpanContext | None":
+    return _current_span.get()
+
+
+def _rand_hex(nbytes: int) -> str:
+    return _os.urandom(nbytes).hex()
+
+
+def _record_span(record: dict) -> None:
+    """Ship one finished span to the head's DEDICATED span ring (not
+    the task-event ring: sharing one deque would let busy task streams
+    evict spans — and vice versa — and force every event consumer to
+    filter foreign records)."""
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    if worker is None:
+        return
+    try:
+        worker._client.notify("span_event", spans=[record])
+    except Exception:
+        pass
+
+
+@contextmanager
+def span(name: str, **attributes):
+    """Open a span; nests under the current one (including a parent
+    propagated from a remote caller). Usable in drivers and tasks."""
+    parent = _current_span.get()
+    ctx = SpanContext(
+        parent.trace_id if parent else _rand_hex(16), _rand_hex(8)
+    )
+    start = _time.time_ns()
+    token = _current_span.set(ctx)
+    error = None
+    try:
+        yield ctx
+    except BaseException as e:  # noqa: BLE001 — recorded then re-raised
+        error = repr(e)
+        raise
+    finally:
+        _current_span.reset(token)
+        _record_span({
+            "name": name,
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+            "parent_span_id": parent.span_id if parent else "",
+            "start_ns": start,
+            "end_ns": _time.time_ns(),
+            "attributes": {
+                **{str(k): str(v) for k, v in attributes.items()},
+                **ctx.attributes,
+                **({"error": error} if error else {}),
+            },
+        })
+
+
+@contextmanager
+def remote_parent(trace_ctx: "dict | None"):
+    """Adopt a caller-propagated span context (worker-side, around
+    task execution)."""
+    if not trace_ctx:
+        yield
+        return
+    token = _current_span.set(
+        SpanContext(trace_ctx["trace_id"], trace_ctx["span_id"])
+    )
+    try:
+        yield
+    finally:
+        _current_span.reset(token)
+
+
+def _otlp_value(v: str) -> dict:
+    return {"stringValue": v}
+
+
+def spans_to_otlp(records) -> dict:
+    """Span records -> one OTLP/JSON ExportTraceServiceRequest."""
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [{
+                "key": "service.name",
+                "value": _otlp_value("ray_tpu"),
+            }]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.util.tracing"},
+                "spans": [{
+                    "traceId": r["trace_id"],
+                    "spanId": r["span_id"],
+                    **({"parentSpanId": r["parent_span_id"]}
+                       if r.get("parent_span_id") else {}),
+                    "name": r["name"],
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": str(r["start_ns"]),
+                    "endTimeUnixNano": str(r["end_ns"]),
+                    "attributes": [
+                        {"key": k, "value": _otlp_value(v)}
+                        for k, v in (r.get("attributes") or {}).items()
+                    ],
+                } for r in records],
+            }],
+        }]
+    }
+
+
+def export_otlp(path: "str | None" = None) -> dict:
+    """Fetch recorded spans from the head and write/return OTLP JSON
+    (`ray.timeline()`'s role for the span world)."""
+    from .. import exceptions as exc
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    if worker is None:
+        raise exc.RayTpuError(
+            "export_otlp() requires an initialized session "
+            "(call ray_tpu.init() first)"
+        )
+    records = worker.call("list_spans", limit=10000)["spans"]
+    otlp = spans_to_otlp(records)
+    if path:
+        with open(path, "w") as f:
+            json.dump(otlp, f)
+    return otlp

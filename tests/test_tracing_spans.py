@@ -1,0 +1,149 @@
+"""Distributed spans with OTLP export (reference slot:
+python/ray/util/tracing — OTel spans around submission/execution with
+remote context propagation; §5.1)."""
+
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def session():
+    rt.init(num_cpus=2)
+    yield
+    rt.shutdown()
+
+
+def test_remote_task_spans_link_to_caller(session):
+    @rt.remote
+    def child():
+        with tracing.span("inside-child", flavor="work"):
+            time.sleep(0.01)
+        return 1
+
+    with tracing.span("driver-root") as root:
+        assert rt.get(child.remote(), timeout=30) == 1
+
+    deadline = time.time() + 10
+    spans = []
+    while time.time() < deadline:
+        otlp = tracing.export_otlp()
+        spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        if len(spans) >= 3:
+            break
+        time.sleep(0.2)
+    by_name = {s["name"]: s for s in spans}
+    assert {"driver-root", "task:child", "inside-child"} <= set(by_name)
+    # One trace, parented: root -> task:child -> inside-child.
+    assert all(
+        s["traceId"] == by_name["driver-root"]["traceId"]
+        for s in by_name.values()
+    )
+    assert (
+        by_name["task:child"]["parentSpanId"]
+        == by_name["driver-root"]["spanId"]
+    )
+    assert (
+        by_name["inside-child"]["parentSpanId"]
+        == by_name["task:child"]["spanId"]
+    )
+    assert "parentSpanId" not in by_name["driver-root"]
+    # OTLP shape: ns timestamps as strings, attributes as kv list.
+    child_span = by_name["inside-child"]
+    assert int(child_span["endTimeUnixNano"]) > int(
+        child_span["startTimeUnixNano"]
+    )
+    assert {"key": "flavor", "value": {"stringValue": "work"}} in (
+        child_span["attributes"]
+    )
+
+
+def test_untraced_tasks_create_no_spans(session):
+    @rt.remote
+    def plain():
+        return 1
+
+    assert rt.get(plain.remote(), timeout=30) == 1
+    time.sleep(0.5)
+    otlp = tracing.export_otlp()
+    spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert not [s for s in spans if s["name"] == "task:plain"]
+
+
+def test_error_recorded_on_span(session):
+    with pytest.raises(ValueError):
+        with tracing.span("fails"):
+            raise ValueError("boom")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        otlp = tracing.export_otlp()
+        spans = [
+            s
+            for s in otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            if s["name"] == "fails"
+        ]
+        if spans:
+            break
+        time.sleep(0.2)
+    assert spans
+    attrs = {a["key"]: a["value"]["stringValue"] for a in spans[0]["attributes"]}
+    assert "boom" in attrs.get("error", "")
+
+
+def test_failed_task_span_records_error(session):
+    @rt.remote
+    def dies():
+        raise RuntimeError("task-went-boom")
+
+    with tracing.span("root-f"):
+        with pytest.raises(Exception):
+            rt.get(dies.remote(), timeout=30)
+    deadline = time.time() + 10
+    task_spans = []
+    while time.time() < deadline:
+        otlp = tracing.export_otlp()
+        task_spans = [
+            s
+            for s in otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            if s["name"] == "task:dies"
+        ]
+        if task_spans:
+            break
+        time.sleep(0.2)
+    assert task_spans
+    attrs = {
+        a["key"]: a["value"]["stringValue"]
+        for a in task_spans[0]["attributes"]
+    }
+    assert "task-went-boom" in attrs.get("error", "")
+
+
+def test_actor_creation_links_to_caller(session):
+    @rt.remote
+    class Traced:
+        def __init__(self):
+            with tracing.span("init-work"):
+                pass
+
+        def ping(self):
+            return 1
+
+    with tracing.span("actor-root") as root:
+        a = Traced.remote()
+        assert rt.get(a.ping.remote(), timeout=30) == 1
+        root_trace = root.trace_id
+    deadline = time.time() + 10
+    by_name = {}
+    while time.time() < deadline:
+        otlp = tracing.export_otlp()
+        by_name = {
+            s["name"]: s
+            for s in otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        }
+        if "init-work" in by_name:
+            break
+        time.sleep(0.2)
+    assert by_name["init-work"]["traceId"] == root_trace
