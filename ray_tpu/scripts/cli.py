@@ -237,6 +237,33 @@ def cmd_jobs(args) -> None:
     print(json.dumps(client.list_jobs(), indent=2, default=str))
 
 
+def cmd_dashboard(args) -> None:
+    """Serve the dashboard against a running cluster until SIGINT /
+    SIGTERM (reference: the head starts ray's dashboard; here it
+    attaches to any cluster as a driver)."""
+    import signal
+    import time
+
+    rt = _connect(args)
+    from ..dashboard import start_dashboard
+
+    dash = start_dashboard(port=args.port)
+    print(f"dashboard: http://127.0.0.1:{dash.port}", flush=True)
+    stop = {"flag": False}
+
+    def on_term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        dash.stop()
+        rt.shutdown()
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="TPU-native distributed runtime CLI"
@@ -306,6 +333,13 @@ def main(argv=None) -> None:
     p_jobs = sub.add_parser("jobs", help="list submitted jobs")
     p_jobs.add_argument("--address")
     p_jobs.set_defaults(fn=cmd_jobs)
+
+    p_dash = sub.add_parser(
+        "dashboard", help="serve the dashboard for a running cluster"
+    )
+    p_dash.add_argument("--address")
+    p_dash.add_argument("--port", type=int, default=8265)
+    p_dash.set_defaults(fn=cmd_dashboard)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -167,3 +167,65 @@ def test_cli_start_status_submit_stop(tmp_path):
     finally:
         if head.poll() is None:
             head.send_signal(signal.SIGKILL)
+
+
+def test_cli_dashboard_serves(tmp_path):
+    """`python -m ray_tpu dashboard` attaches to a running cluster and
+    serves the SPA + API."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import time
+    import urllib.request
+
+    import ray_tpu as rt
+
+    rt.init(num_cpus=1)
+    try:
+        address = rt.api._session.daemon.socket_path
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu", "dashboard",
+                "--address", address, "--port", "0",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        try:
+            # readline blocks without a timeout — scan on a thread so
+            # a wedged subprocess yields a diagnostic, not a hang.
+            found = {"url": None, "out": []}
+            ready = threading.Event()
+
+            def scan():
+                for line in proc.stdout:
+                    found["out"].append(line)
+                    if "dashboard:" in line:
+                        found["url"] = line.split("dashboard:")[1].strip()
+                        ready.set()
+                        return
+
+            t = threading.Thread(target=scan, daemon=True)
+            t.start()
+            assert ready.wait(30), (
+                f"dashboard never came up: {found['out'][-5:]}"
+            )
+            nodes = json.loads(
+                urllib.request.urlopen(
+                    found["url"] + "/api/nodes", timeout=10
+                ).read()
+            )
+            assert len(nodes) >= 1
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=10)
+    finally:
+        rt.shutdown()
