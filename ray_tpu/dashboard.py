@@ -56,7 +56,7 @@ _PAGE = """<!doctype html>
 </main>
 <script>
 const TABS = ["nodes","actors","tasks","objects","placement_groups",
-              "resources","metrics"];
+              "resources","metrics","spans"];
 let active = "nodes";
 const $ = (id) => document.getElementById(id);
 function tabs() {
@@ -187,6 +187,7 @@ class Dashboard:
                 "available": ray_tpu.available_resources(),
             },
             "metrics": self._metrics,
+            "spans": self._spans,
         }
         fn = handlers.get(kind)
         if fn is None:
@@ -200,6 +201,28 @@ class Dashboard:
         from .util.metrics import metrics_summary
 
         return metrics_summary()
+
+    @staticmethod
+    def _spans():
+        """Most recent tracing spans, newest first (full OTLP export
+        via util.tracing.export_otlp)."""
+        from ._private.worker import global_worker
+
+        worker = global_worker()
+        if worker is None:
+            return []
+        records = worker.call("list_spans", limit=200)["spans"]
+        return [
+            {
+                "name": r["name"],
+                "trace": r["trace_id"][:8],
+                "span": r["span_id"][:8],
+                "parent": (r.get("parent_span_id") or "")[:8],
+                "ms": round((r["end_ns"] - r["start_ns"]) / 1e6, 2),
+                "attributes": r.get("attributes") or {},
+            }
+            for r in reversed(records)
+        ]
 
     def _route(self, path: str):
         if path.startswith("/api/"):
