@@ -13,6 +13,7 @@ from .api import (
     cluster_resources,
     get,
     get_actor,
+    get_runtime_context,
     init,
     is_initialized,
     kill,
@@ -41,6 +42,7 @@ __all__ = [
     "kill",
     "cancel",
     "get_actor",
+    "get_runtime_context",
     "nodes",
     "cluster_resources",
     "available_resources",

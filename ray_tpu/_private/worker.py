@@ -16,6 +16,7 @@ memory and only seal notifications hit the daemon.
 
 from __future__ import annotations
 
+import contextvars
 import inspect
 import itertools
 import os
@@ -56,6 +57,13 @@ def _split_kwargs(flat):
     ):
         return list(flat[:-1]), dict(flat[-1][1])
     return list(flat), {}
+
+
+#: Task identity inside async actor coroutines (thread-locals don't
+#: cross onto the shared event-loop thread; see _run_coroutine).
+_ASYNC_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_async_task_id", default=None
+)
 
 
 def _trace_ctx() -> Optional[dict]:
@@ -942,8 +950,19 @@ class CoreWorker:
                 )
                 thread.start()
                 self._actor_loop = loop
+
+        # Thread-local task identity doesn't reach the loop thread, and
+        # a thread-local SET there would clobber across interleaved
+        # coroutines — carry it in a contextvar, which asyncio keeps
+        # task-local (each asyncio.Task copies the context).
+        task_id = self._ctx.task_id
+
+        async def _with_task_ctx():
+            _ASYNC_TASK_ID.set(task_id)
+            return await coro
+
         return asyncio.run_coroutine_threadsafe(
-            coro, self._actor_loop
+            _with_task_ctx(), self._actor_loop
         ).result()
 
     def _direct_reply(self, reply_to, payload: dict) -> None:

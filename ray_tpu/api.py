@@ -162,3 +162,53 @@ def timeline() -> List[dict]:
 
 def state_summary() -> dict:
     return _worker().call("state_summary")["summary"]
+
+
+class RuntimeContext:
+    """Execution-context introspection (reference:
+    python/ray/runtime_context.py:30 RuntimeContext — get_job_id /
+    get_node_id / get_task_id / get_actor_id / get_worker_id /
+    get_accelerator_ids via ray.get_runtime_context())."""
+
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get_job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    def get_node_id(self) -> str:
+        return self._worker.node_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        """Id of the task this code runs inside; None on a driver."""
+        task_id = getattr(self._worker._ctx, "task_id", None)
+        if task_id is None:
+            # Async actor methods run on the shared event-loop thread,
+            # where identity rides a (asyncio-task-local) contextvar.
+            from ._private.worker import _ASYNC_TASK_ID
+
+            task_id = _ASYNC_TASK_ID.get()
+        return task_id.hex() if task_id is not None else None
+
+    def get_actor_id(self) -> Optional[str]:
+        """Id of the actor this code runs inside; None elsewhere."""
+        actor_id = self._worker._actor_id
+        return actor_id.hex() if actor_id is not None else None
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        """Accelerator ids visible to THIS worker (reference:
+        RuntimeContext.get_accelerator_ids; TPU chip visibility rides
+        TPU_VISIBLE_CHIPS, accelerators/tpu.py)."""
+        import os as _os
+
+        chips = _os.environ.get("TPU_VISIBLE_CHIPS", "")
+        return {"TPU": [c for c in chips.split(",") if c]}
+
+
+def get_runtime_context() -> RuntimeContext:
+    """The context of the current driver/task/actor (reference:
+    python/ray/runtime_context.py:520 get_runtime_context)."""
+    return RuntimeContext(_worker())

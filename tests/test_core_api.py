@@ -379,3 +379,59 @@ def test_task_events_recorded():
         time.sleep(0.1)
     assert "RUNNING" in states
     assert "FINISHED" in states
+
+
+def test_runtime_context():
+    """get_runtime_context() exposes job/node/task/actor identity in
+    every execution context (reference: runtime_context.py:30)."""
+    ctx = rt.get_runtime_context()
+    assert len(ctx.get_job_id()) > 0
+    assert len(ctx.get_node_id()) == 32
+    assert ctx.get_task_id() is None  # driver
+    assert ctx.get_actor_id() is None
+    assert "TPU" in ctx.get_accelerator_ids()
+
+    @rt.remote
+    def inside_task():
+        c = rt.get_runtime_context()
+        return (c.get_task_id(), c.get_actor_id(), c.get_job_id())
+
+    task_id, actor_id, job_id = rt.get(inside_task.remote(), timeout=30)
+    assert task_id is not None and actor_id is None
+    assert job_id == ctx.get_job_id()
+
+    @rt.remote
+    class Inside:
+        def who(self):
+            c = rt.get_runtime_context()
+            return (c.get_actor_id(), c.get_task_id())
+
+    a = Inside.remote()
+    actor_id, task_id = rt.get(a.who.remote(), timeout=30)
+    assert actor_id is not None and task_id is not None
+
+
+def test_runtime_context_async_actor():
+    """Task identity inside ASYNC actor methods (coroutines run on the
+    shared loop thread; identity rides an asyncio-task-local
+    contextvar, so interleaved calls can't cross-contaminate)."""
+
+    @rt.remote(max_concurrency=4)
+    class AsyncIdent:
+        async def who(self):
+            import asyncio
+
+            c = rt.get_runtime_context()
+            first = c.get_task_id()
+            await asyncio.sleep(0.05)  # force interleaving
+            return (first, c.get_task_id())
+
+    a = AsyncIdent.remote()
+    pairs = rt.get([a.who.remote() for _ in range(4)], timeout=30)
+    ids = set()
+    for first, after_await in pairs:
+        assert first is not None
+        # Identity survives the await AND is unique per call.
+        assert first == after_await
+        ids.add(first)
+    assert len(ids) == 4
