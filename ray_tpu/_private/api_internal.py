@@ -158,8 +158,13 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         "methods": ac.method_names(),
         "class_key": class_key,
     }
+    # Actors default to 1 CPU held for their lifetime (creation
+    # resources are not released while alive); an EXPLICIT num_cpus=0
+    # yields {} — schedulable anywhere in any number (reference:
+    # ray_option_utils.py actor defaults; docs "actors require 1 CPU
+    # for scheduling", num_cpus=0 to oversubscribe).
     resources, strategy, pg_context = _resolve_placement(
-        opts, _task_resources(opts, default_cpu=0.0), worker
+        opts, _task_resources(opts, default_cpu=1.0), worker
     )
     actor_id = worker.create_actor(
         class_key,

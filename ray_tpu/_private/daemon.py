@@ -205,6 +205,7 @@ class NodeDaemon:
         self.workers: Dict[int, WorkerInfo] = {}  # conn_id -> info
         self.drivers: Dict[int, JobID] = {}  # conn_id -> job
         self._spawning = 0
+        self._fork_server = None  # warm worker template (lazy)
         self._spawn_failures = 0
         self._shutdown = False
         self._worker_procs: List[subprocess.Popen] = []
@@ -237,6 +238,12 @@ class NodeDaemon:
             4, int(4 * resources.get("CPU", 1))
         )
         self._max_workers = max_workers
+        # In-flight worker-process startups allowed at once (reference:
+        # worker_pool.cc maximum_startup_concurrency = num_cpus). Actor
+        # creations spawn past _max_workers but never past this gate.
+        self._startup_concurrency = max(
+            2, int(resources.get("CPU", 1))
+        )
 
         # Head-only state.
         self.control: Optional[ControlState] = None
@@ -420,6 +427,10 @@ class NodeDaemon:
 
     def start(self) -> None:
         self.server.start()
+        # Launch the fork-server template early (non-blocking) so its
+        # one-time import phase overlaps daemon startup instead of
+        # stalling the first worker spawn.
+        self._ensure_fork_server()
         if self.is_head:
             self._redispatch_restored_creations()
         threading.Thread(
@@ -3329,10 +3340,7 @@ class NodeDaemon:
                 None,
             )
             if worker is None:
-                if (
-                    len(self.workers) + self._spawning < self._max_workers
-                ):
-                    self._spawn_worker(needs_tpu)
+                self._spawn_for_dispatch(spec, needs_tpu)
                 return False
             worker.idle = False
             worker.current_task = task_id
@@ -3341,6 +3349,41 @@ class NodeDaemon:
         self._record_task_event(spec, "RUNNING")
         worker.conn.push("execute_task", {"spec": spec})
         return True
+
+    def _spawn_for_dispatch(self, spec: dict, needs_tpu: bool) -> None:
+        """No idle worker took `spec`: grow the pool (caller holds
+        self._lock)."""
+        if spec["kind"] == "actor_creation":
+            # Actors get DEDICATED workers exempt from the task-pool
+            # cap — admission is controlled by the actor's resource
+            # request, and a capped pool would deadlock many-actor
+            # apps (reference: worker_pool starts one process per
+            # actor; only in-flight startups are bounded,
+            # worker_pool.cc maximum_startup_concurrency). Spawn
+            # enough to cover the queued same-type creations (this
+            # spec is out of the queue while being tried: +1).
+            want = 1 + self.scheduler.count_queued(
+                lambda s: s.get("kind") == "actor_creation"
+                and (s.get("resources", {}).get("TPU", 0) > 0)
+                == needs_tpu
+            )
+            while (
+                self._spawning < self._startup_concurrency
+                and want > self._spawning
+            ):
+                self._spawn_worker(needs_tpu)
+        elif self._task_pool_size() + self._spawning < self._max_workers:
+            self._spawn_worker(needs_tpu)
+
+    def _task_pool_size(self) -> int:
+        """Workers countable against the task-pool cap (caller holds
+        self._lock). Actor-pinned workers are dedicated for the
+        actor's lifetime and never return to the pool — counting them
+        would let a few long-lived actors permanently starve plain
+        tasks of worker spawns."""
+        return sum(
+            1 for w in self.workers.values() if w.pinned_actor is None
+        )
 
     def _try_grant_lease(self, lease_id, spec: dict, needs_tpu: bool) -> bool:
         """Dispatch callback for lease pseudo-tasks: hand an idle
@@ -3365,7 +3408,8 @@ class NodeDaemon:
             )
             if worker is None:
                 if (
-                    len(self.workers) + self._spawning < self._max_workers
+                    self._task_pool_size() + self._spawning
+                    < self._max_workers
                 ):
                     self._spawn_worker(needs_tpu)
                 return False
@@ -3382,8 +3426,7 @@ class NodeDaemon:
         )
         return True
 
-    def _spawn_worker(self, needs_tpu: bool = False) -> None:
-        self._spawning += 1
+    def _worker_env(self, needs_tpu: bool) -> dict:
         env = dict(os.environ)
         env["RT_SOCKET"] = self.socket_path
         env["RT_WORKER_TPU"] = "1" if needs_tpu else "0"
@@ -3402,18 +3445,52 @@ class NodeDaemon:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
         )
+        return env
+
+    def _ensure_fork_server(self):
+        """Warm fork-server template for this node (lazy; cpu-scoped
+        env — TPU workers override per spawn)."""
+        if self._fork_server is None and self.config.worker_fork_server:
+            from .worker_forkserver import ForkServerClient
+
+            self._fork_server = ForkServerClient(
+                self._worker_env(needs_tpu=False),
+                os.path.join(self.session_dir, "forkserver.out"),
+            )
+            self._fork_server.start()
+        return self._fork_server
+
+    def _spawn_worker(self, needs_tpu: bool = False) -> None:
+        self._spawning += 1
         log_path = os.path.join(
             self.session_dir, f"worker-{len(self._worker_procs)}.out"
         )
-        with open(log_path, "ab") as log_file:
-            # The child holds its own copy of the fd; closing ours
-            # immediately avoids leaking one fd per spawn.
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                env=env,
-                stdout=log_file,
-                stderr=subprocess.STDOUT,
+        proc = None
+        fork_server = self._ensure_fork_server()
+        if fork_server is not None:
+            # Per-spawn deltas derived as a diff against the template's
+            # base env (one source of truth: _worker_env; None unsets).
+            base = self._worker_env(needs_tpu=False)
+            want = self._worker_env(needs_tpu)
+            overrides = {
+                k: v for k, v in want.items() if base.get(k) != v
+            }
+            overrides.update(
+                {k: None for k in base if k not in want}
             )
+            proc = fork_server.spawn(log_path, overrides)
+        if proc is None:
+            # Cold path: fork server disabled or crashed twice.
+            with open(log_path, "ab") as log_file:
+                # The child holds its own copy of the fd; closing ours
+                # immediately avoids leaking one fd per spawn.
+                proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu._private.worker_main"],
+                    env=self._worker_env(needs_tpu),
+                    stdout=log_file,
+                    stderr=subprocess.STDOUT,
+                )
         self._worker_procs.append(proc)
         self._watch_worker_start(proc)
 
@@ -3807,6 +3884,8 @@ class NodeDaemon:
                 proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
                 pass
+        if self._fork_server is not None:
+            self._fork_server.close()
         if self.head is not None:
             try:
                 self.head.close()

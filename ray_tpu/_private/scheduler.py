@@ -144,6 +144,15 @@ class LocalScheduler:
         with self._lock:
             return len(self._queue)
 
+    def count_queued(self, predicate) -> int:
+        """Number of queued tasks whose spec matches `predicate`."""
+        with self._lock:
+            return sum(
+                1
+                for _req, spec in self._queue.values()
+                if predicate(spec)
+            )
+
     def maybe_dispatch(
         self,
         deps_ready: Callable[[object], bool],

@@ -757,7 +757,13 @@ class CoreWorker:
             "function_key": func_key,
             "args": self._serialize_args(args),
             "returns": [r.binary() for r in returns],
-            "resources": resources or {"CPU": 1.0},
+            # `resources={}` is a real request (zero-resource task; the
+            # reference schedules these anywhere, ray_option_utils.py
+            # num_cpus=0) — only None means "caller didn't resolve
+            # options" and gets the 1-CPU default.
+            "resources": (
+                resources if resources is not None else {"CPU": 1.0}
+            ),
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
@@ -800,7 +806,12 @@ class CoreWorker:
             "function_key": class_key,
             "args": self._serialize_args(args),
             "returns": [ObjectID.for_return(task_id, 1).binary()],
-            "resources": resources or {"CPU": 1.0},
+            # Explicit num_cpus=0 actors request {} — unlimited packing
+            # (the reference's many-replica escape hatch); None keeps
+            # the 1-CPU scheduling default applied in api_internal.
+            "resources": (
+                resources if resources is not None else {"CPU": 1.0}
+            ),
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,

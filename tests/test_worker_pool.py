@@ -76,3 +76,63 @@ def test_actor_pinned_workers_never_reaped():
         ) == ["alive"] * 3
     finally:
         rt.shutdown()
+
+
+def test_zero_cpu_actors_pack_past_worker_cap():
+    """An EXPLICIT num_cpus=0 actor requests {} — any number of them
+    pack onto a node, each on a DEDICATED worker past the task-pool
+    cap (reference: ray_option_utils.py num_cpus=0 actors; worker_pool
+    starts one process per actor, bounded only by startup
+    concurrency). Regression: `resources or {"CPU": 1.0}` turned the
+    empty request back into 1 CPU and the pool cap deadlocked the
+    creations."""
+    rt.init(num_cpus=1, _system_config={"max_workers_per_node": 2})
+    try:
+        @rt.remote(num_cpus=0)
+        class Slot:
+            def pid(self):
+                import os
+
+                return os.getpid()
+
+        # 6 actors on a 1-CPU node with a 2-worker task cap: only
+        # possible if creations bypass the cap with dedicated workers.
+        actors = [Slot.remote() for _ in range(6)]
+        pids = rt.get([a.pid.remote() for a in actors], timeout=90)
+        assert len(set(pids)) == 6
+
+        # Pinned actor workers must not count against the task-pool
+        # cap: a plain task still gets a worker spawned for it.
+        @rt.remote
+        def plain():
+            return 42
+
+        assert rt.get(plain.remote(), timeout=60) == 42
+    finally:
+        rt.shutdown()
+
+
+def test_fork_server_spawns_workers():
+    """Workers come from the warm fork-server template by default;
+    they must execute tasks and report distinct pids (the template's
+    children, not the daemon's)."""
+    rt.init(num_cpus=4)
+    try:
+        daemon = rt.api._session.daemon
+        assert daemon._fork_server is not None
+
+        @rt.remote
+        def whoami():
+            import os
+
+            return os.getpid(), os.getppid()
+
+        pid, ppid = rt.get(whoami.remote(), timeout=60)
+        assert pid != ppid
+        # The worker's parent is the fork-server template, not the
+        # daemon's own process.
+        import os as _os
+
+        assert ppid != _os.getpid()
+    finally:
+        rt.shutdown()
