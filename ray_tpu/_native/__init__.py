@@ -137,6 +137,20 @@ def load_library() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:
             pass
+        try:  # futex doorbell (added with the DAG channel wakeups)
+            lib.rts_futex_wait_u32.restype = ctypes.c_int
+            lib.rts_futex_wait_u32.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint32,
+                ctypes.c_int64,
+            ]
+            lib.rts_futex_wake.restype = ctypes.c_int
+            lib.rts_futex_wake.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int,
+            ]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 

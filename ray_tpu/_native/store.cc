@@ -19,9 +19,12 @@
 #include <cstring>
 
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <pthread.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
 #include <unistd.h>
 
 namespace {
@@ -620,6 +623,31 @@ uint64_t rts_load_acq_u64(const void* p) {
 
 void rts_store_rel_u64(void* p, uint64_t v) {
   __atomic_store_n(static_cast<uint64_t*>(p), v, __ATOMIC_RELEASE);
+}
+
+// Futex doorbell for the SPSC channel counters (dag/channels.py).
+// The waiter sleeps in the kernel on the LOW 32 bits of a u64
+// head/tail counter (little-endian: the low word changes on every
+// advance) instead of sleep-polling; the peer rings after each
+// counter store. Non-PRIVATE futexes are required — the two sides
+// are different processes mapping the same segment (reference
+// semantics: mutable-object WaitForWritten/WaitForReadable,
+// core_worker/experimental_mutable_object_manager.h:48,153).
+int rts_futex_wait_u32(void* p, uint32_t expected, int64_t timeout_ns) {
+  struct timespec ts;
+  struct timespec* tsp = nullptr;
+  if (timeout_ns >= 0) {
+    ts.tv_sec = timeout_ns / 1000000000;
+    ts.tv_nsec = timeout_ns % 1000000000;
+    tsp = &ts;
+  }
+  long rc = syscall(SYS_futex, p, FUTEX_WAIT, expected, tsp, nullptr, 0);
+  return rc == 0 ? 0 : -errno;
+}
+
+int rts_futex_wake(void* p, int n) {
+  long rc = syscall(SYS_futex, p, FUTEX_WAKE, n, nullptr, nullptr, 0);
+  return rc >= 0 ? static_cast<int>(rc) : -errno;
 }
 
 }  // extern "C"

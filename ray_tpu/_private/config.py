@@ -93,7 +93,10 @@ class Config:
     #: admission is the real concurrency gate, so this must stay above
     #: any concurrency the declared resources can admit.
     direct_call_max_leases: int = 64
-    #: Hard cap on worker processes started per node. 0 = 4 * num_cpus.
+    #: Cap on the TASK worker pool per node (0 = 4 * num_cpus).
+    #: Actor-dedicated workers are exempt — one per live actor,
+    #: admission-controlled by the actor's resource request — so total
+    #: processes on an actor-heavy node can exceed this.
     max_workers_per_node: int = 0
     #: Spawn workers by forking a warm pre-imported template process
     #: (~10ms/worker) instead of cold `python -m` (~250ms/worker).

@@ -31,6 +31,7 @@ protocol, src/ray/object_manager/plasma/store.h).
 from __future__ import annotations
 
 import os
+import queue
 import subprocess
 import sys
 import threading
@@ -206,6 +207,13 @@ class NodeDaemon:
         self.drivers: Dict[int, JobID] = {}  # conn_id -> job
         self._spawning = 0
         self._fork_server = None  # warm worker template (lazy)
+        self._fork_server_lock = threading.Lock()
+        # Worker spawns run on a dedicated thread: the fork-server
+        # handshake (and a cold Popen on a loaded box) does blocking
+        # I/O that must never run under self._lock — every dispatch,
+        # registration and heartbeat handler needs that lock.
+        self._spawn_queue: "queue.Queue" = queue.Queue()
+        self._spawn_thread: Optional[threading.Thread] = None
         self._spawn_failures = 0
         self._shutdown = False
         self._worker_procs: List[subprocess.Popen] = []
@@ -3450,18 +3458,50 @@ class NodeDaemon:
     def _ensure_fork_server(self):
         """Warm fork-server template for this node (lazy; cpu-scoped
         env — TPU workers override per spawn)."""
-        if self._fork_server is None and self.config.worker_fork_server:
-            from .worker_forkserver import ForkServerClient
+        with self._fork_server_lock:
+            if (
+                self._fork_server is None
+                and self.config.worker_fork_server
+            ):
+                from .worker_forkserver import ForkServerClient
 
-            self._fork_server = ForkServerClient(
-                self._worker_env(needs_tpu=False),
-                os.path.join(self.session_dir, "forkserver.out"),
-            )
-            self._fork_server.start()
-        return self._fork_server
+                self._fork_server = ForkServerClient(
+                    self._worker_env(needs_tpu=False),
+                    os.path.join(self.session_dir, "forkserver.out"),
+                )
+                self._fork_server.start()
+            return self._fork_server
 
     def _spawn_worker(self, needs_tpu: bool = False) -> None:
+        """Request one worker spawn (non-blocking; callers hold
+        self._lock). The actual fork/exec happens on the spawner
+        thread — its pipe handshake must never stall dispatch."""
         self._spawning += 1
+        if self._spawn_thread is None:
+            self._spawn_thread = threading.Thread(
+                target=self._spawn_loop, daemon=True,
+                name=f"spawn:{self.node_id.hex()[:8]}",
+            )
+            self._spawn_thread.start()
+        self._spawn_queue.put(needs_tpu)
+
+    def _spawn_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                needs_tpu = self._spawn_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self._spawn_worker_blocking(needs_tpu)
+            except Exception:
+                # Counted like a pre-registration death so the spawn
+                # slot is reclaimed and the queue can't starve.
+                with self._lock:
+                    self._spawning = max(0, self._spawning - 1)
+                    self._spawn_failures += 1
+                self._schedule()
+
+    def _spawn_worker_blocking(self, needs_tpu: bool) -> None:
         log_path = os.path.join(
             self.session_dir, f"worker-{len(self._worker_procs)}.out"
         )

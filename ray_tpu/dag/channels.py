@@ -43,6 +43,34 @@ def _atomics():
 
 _ATOMICS = _atomics()
 
+
+def _futex():
+    """(wait, wake) on the low u32 word of a counter, or None. The
+    kernel-sleep half of the doorbell; spin covers the hot path."""
+    try:
+        from .._native import load_library
+
+        lib = load_library()
+        if lib is not None and hasattr(lib, "rts_futex_wait_u32"):
+            return lib.rts_futex_wait_u32, lib.rts_futex_wake
+    except Exception:
+        pass
+    return None
+
+
+_FUTEX = _futex()
+#: Hot-spin budget before sleeping in the kernel: covers the common
+#: compiled-pipeline turnaround (~tens of us) without a syscall. On a
+#: single-CPU machine spinning is counterproductive — the waiter burns
+#: the exact quantum its peer needs to produce the data — so go
+#: straight to the futex there.
+import os as _os
+
+_SPIN_NS = 100_000 if (_os.cpu_count() or 1) > 1 else 0
+#: Bounded kernel waits so a peer's close() (shared flag, no doorbell
+#: reachable after unmap) is noticed promptly even with no traffic.
+_WAIT_CHUNK_NS = 20_000_000
+
 STOP = b"__RT_DAG_STOP__"
 
 
@@ -148,6 +176,50 @@ class ShmChannel:
             out += bytes(self._shm.buf[_HEADER : _HEADER + size - first])
         return out
 
+    # -- blocking ------------------------------------------------------
+    def _await(self, cond, watch_offset: int, timeout, label: str):
+        """Block until `cond()` holds. Adaptive: hot-spin for a short
+        budget (covers the in-flight-producer case with zero
+        syscalls), then sleep in the kernel on the counter at
+        `watch_offset` via futex until the peer's doorbell — or
+        sleep-poll when the native library is absent. The futex
+        compares the counter's low u32 in-kernel, so a wake between
+        snapshot and sleep can't be lost (reference semantics:
+        mutable-object WaitForWritten/WaitForReadable,
+        core_worker/experimental_mutable_object_manager.h:48,153 —
+        which block on a shared condvar, same shape)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin_until = time.monotonic_ns() + _SPIN_NS
+        while not cond():
+            if self._closed or self._shared_closed():
+                raise ChannelClosedError(self.name)
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"{label} on {self.name}")
+            if _FUTEX is None or _ATOMICS is None:
+                time.sleep(0.0002)
+                continue
+            if time.monotonic_ns() < spin_until:
+                continue
+            with self._io_lock:
+                if self._closed:
+                    raise ChannelClosedError(self.name)
+                addr = self._base_addr + watch_offset
+                snap = int(_ATOMICS[0](addr)) & 0xFFFFFFFF
+            # Bounded sleep; EAGAIN (counter already moved) and
+            # spurious wakeups just re-run the loop. The segment can't
+            # be unmapped out from under the kernel wait by our own
+            # close() (io_lock above re-checked _closed), and a peer
+            # unmap at worst faults the wait into an error return.
+            _FUTEX[0](addr, snap, _WAIT_CHUNK_NS)
+
+    def _ring_doorbell(self, watch_offset: int) -> None:
+        if _FUTEX is None:
+            return
+        with self._io_lock:
+            if self._closed:
+                return
+            _FUTEX[1](self._base_addr + watch_offset, 2**31 - 1)
+
     # -- public --------------------------------------------------------
     def put_bytes(self, payload: bytes, timeout: Optional[float] = None):
         record = len(payload) + _LEN
@@ -157,30 +229,30 @@ class ShmChannel:
                 f"capacity {self.capacity}; recompile with a larger "
                 "buffer_size_bytes"
             )
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while self.capacity - (self._head() - self._tail()) < record:
-            if self._closed or self._shared_closed():
-                raise ChannelClosedError(self.name)
-            if deadline is not None and time.monotonic() > deadline:
-                raise ChannelTimeoutError(f"put on {self.name}")
-            time.sleep(0.0002)
+        # Ring full: wait for the reader to advance tail (offset 8).
+        self._await(
+            lambda: self.capacity - (self._head() - self._tail())
+            >= record,
+            8,
+            timeout,
+            "put",
+        )
         head = self._head()
         self._write_at(head, struct.pack("<Q", len(payload)))
         self._write_at(head + _LEN, payload)
         self._set_head(head + record)
+        self._ring_doorbell(0)  # wake a reader sleeping on head
 
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while self._head() - self._tail() < _LEN:
-            if self._closed or self._shared_closed():
-                raise ChannelClosedError(self.name)
-            if deadline is not None and time.monotonic() > deadline:
-                raise ChannelTimeoutError(f"get on {self.name}")
-            time.sleep(0.0002)
+        # Ring empty: wait for the writer to advance head (offset 0).
+        self._await(
+            lambda: self._head() - self._tail() >= _LEN, 0, timeout, "get"
+        )
         tail = self._tail()
         (size,) = struct.unpack("<Q", self._read_at(tail, _LEN))
         payload = self._read_at(tail + _LEN, size)
         self._set_tail(tail + _LEN + size)
+        self._ring_doorbell(8)  # wake a writer sleeping on tail
         return payload
 
     def put(self, value: Any, timeout: Optional[float] = None) -> None:
@@ -195,6 +267,11 @@ class ShmChannel:
             # put/get on the other side of the ring sees it and raises
             # instead of spinning forever (`_closed` is process-local).
             self._store(16, 1)
+            # Ring both doorbells so a peer sleeping in the kernel
+            # notices immediately (it would otherwise wait out one
+            # bounded chunk).
+            self._ring_doorbell(0)
+            self._ring_doorbell(8)
         except Exception:
             pass
         with self._io_lock:

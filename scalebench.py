@@ -1,0 +1,279 @@
+"""Scalability-envelope stress bench (VERDICT r3 item 1).
+
+Models the reference's release scalability suite
+(reference: release/benchmarks/README.md:7-33 — 1M tasks queued on one
+node, many-object get, many-arg tasks, 1k+ actors, 1 GiB broadcast)
+scaled to a single box: every case boots a REAL multi-daemon runtime
+(in-box Cluster, the same code path a pod runs) and commits measured
+numbers to SCALEBENCH.json.
+
+Each case runs in its own subprocess under a hard timeout so a wedge
+in one case can neither hang the suite nor poison the next case's
+runtime. A case's line is {"seconds": ..., "rate": ..., "ok": bool}.
+
+Usage:
+  python scalebench.py              # run all cases -> SCALEBENCH.json
+  python scalebench.py --case NAME  # run one case, print its JSON
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CASE_TIMEOUT = float(os.environ.get("RT_SCALEBENCH_TIMEOUT", "570"))
+
+
+# ---------------------------------------------------------------------------
+# cases (each runs in a fresh subprocess)
+# ---------------------------------------------------------------------------
+
+def case_tasks_100k_one_daemon() -> dict:
+    """100k nop tasks submitted through one daemon (reference envelope:
+    '1,000,000+ tasks queued on one node' — in-box at 1/10 scale)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8)
+    try:
+        @rt.remote
+        def nop():
+            return None
+
+        rt.get(nop.remote(), timeout=60)
+        n = 100_000
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(n)]
+        submitted = time.perf_counter()
+        rt.get(refs, timeout=CASE_TIMEOUT - 60)
+        dt = time.perf_counter() - t0
+        return {
+            "n": n,
+            "seconds": round(dt, 1),
+            "rate": round(n / dt, 1),
+            "submit_rate": round(n / (submitted - t0), 1),
+            "unit": "tasks/s",
+        }
+    finally:
+        rt.shutdown()
+
+
+def case_get_10k_objects() -> dict:
+    """put 10k objects then one get() over all of them (reference:
+    many_args/many-object wait envelope)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+    try:
+        n = 10_000
+        refs = [rt.put(i) for i in range(n)]
+        t0 = time.perf_counter()
+        vals = rt.get(refs, timeout=300)
+        dt = time.perf_counter() - t0
+        assert vals[-1] == n - 1
+        return {
+            "n": n,
+            "seconds": round(dt, 3),
+            "rate": round(n / dt, 1),
+            "unit": "objects/s",
+        }
+    finally:
+        rt.shutdown()
+
+
+def case_args_and_returns_1k() -> dict:
+    """One task taking 1000 ObjectRef args; one task declaring 1000
+    returns (reference: single_node many-args / many-returns cases)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+    try:
+        @rt.remote
+        def many_args(*args):
+            return len(args)
+
+        @rt.remote(num_returns=1000)
+        def many_returns():
+            return tuple(range(1000))
+
+        args = [rt.put(i) for i in range(1000)]
+        t0 = time.perf_counter()
+        assert rt.get(many_args.remote(*args), timeout=300) == 1000
+        args_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        vals = rt.get(list(many_returns.remote()), timeout=300)
+        returns_s = time.perf_counter() - t0
+        assert vals[-1] == 999
+        return {
+            "args_seconds": round(args_s, 3),
+            "returns_seconds": round(returns_s, 3),
+            "seconds": round(args_s + returns_s, 3),
+        }
+    finally:
+        rt.shutdown()
+
+
+def case_actors_1k_16_daemons() -> dict:
+    """1000 zero-resource actors SPREAD across a 16-daemon in-box
+    cluster, each created on a dedicated worker and pinged once
+    (reference envelope: '10,000+ actors across 1,000 nodes' at
+    in-box scale; actor-per-worker model of worker_pool.cc)."""
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 1.0})
+    try:
+        for _ in range(15):
+            cluster.add_node(num_cpus=1.0)
+        cluster.wait_for_nodes(16, timeout=120)
+        rt.init(address=cluster.address)
+
+        @rt.remote(num_cpus=0)
+        class Slot:
+            def ping(self):
+                return os.getpid()
+
+        n = 1000
+        t0 = time.perf_counter()
+        actors = [
+            Slot.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(n)
+        ]
+        pids = rt.get(
+            [a.ping.remote() for a in actors], timeout=CASE_TIMEOUT - 90
+        )
+        dt = time.perf_counter() - t0
+        distinct = len(set(pids))
+        assert distinct == n, f"expected {n} dedicated workers: {distinct}"
+        return {
+            "n": n,
+            "nodes": 16,
+            "seconds": round(dt, 1),
+            "rate": round(n / dt, 1),
+            "unit": "actors/s",
+        }
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
+def case_broadcast_256mb_8_daemons() -> dict:
+    """One 256 MiB object pulled by a task on each of 8 daemons
+    (reference envelope: '1 GiB broadcast to 50 nodes'; chunked
+    windowed pulls with randomized source selection)."""
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 1.0})
+    try:
+        for _ in range(7):
+            cluster.add_node(num_cpus=1.0)
+        cluster.wait_for_nodes(8, timeout=120)
+        rt.init(address=cluster.address)
+
+        @rt.remote(num_cpus=1)
+        def consume(x):
+            return x.nbytes
+
+        nbytes = 256 * 1024 * 1024
+        blob = np.random.default_rng(0).random(nbytes // 8)
+        assert blob.nbytes == nbytes
+        ref = rt.put(blob)
+        t0 = time.perf_counter()
+        sizes = rt.get(
+            [
+                consume.options(scheduling_strategy="SPREAD").remote(ref)
+                for _ in range(8)
+            ],
+            timeout=CASE_TIMEOUT - 90,
+        )
+        dt = time.perf_counter() - t0
+        assert all(s == nbytes for s in sizes)
+        return {
+            "nbytes": nbytes,
+            "nodes": 8,
+            "seconds": round(dt, 1),
+            "rate": round(8 * nbytes / dt / 1e9, 2),
+            "unit": "GB/s aggregate",
+        }
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
+CASES = {
+    "tasks_100k_one_daemon": case_tasks_100k_one_daemon,
+    "get_10k_objects": case_get_10k_objects,
+    "args_and_returns_1k": case_args_and_returns_1k,
+    "actors_1k_16_daemons": case_actors_1k_16_daemons,
+    "broadcast_256mb_8_daemons": case_broadcast_256mb_8_daemons,
+}
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def _run_case_subprocess(name: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # runtime-bound: keep off the chip
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH", "")) if p
+    )
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scalebench.py"),
+             "--case", name],
+            capture_output=True,
+            text=True,
+            timeout=CASE_TIMEOUT,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timeout after {CASE_TIMEOUT}s"}
+    if proc.returncode != 0:
+        return {
+            "ok": False,
+            "error": (proc.stderr or "")[-1500:],
+            "seconds": round(time.perf_counter() - t0, 1),
+        }
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            result = json.loads(line)
+            result["ok"] = True
+            return result
+    return {"ok": False, "error": "no JSON line in case output"}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--case", choices=sorted(CASES))
+    args = parser.parse_args()
+
+    if args.case:
+        print(json.dumps(CASES[args.case]()))
+        return
+
+    results: dict = {}
+    for name in CASES:
+        print(f"[scalebench] {name} ...", file=sys.stderr, flush=True)
+        results[name] = _run_case_subprocess(name)
+        print(f"[scalebench] {name}: {json.dumps(results[name])}",
+              file=sys.stderr, flush=True)
+        with open(os.path.join(REPO, "SCALEBENCH.json"), "w") as f:
+            json.dump(results, f, indent=2)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
