@@ -5,20 +5,31 @@ The TPU-native replacement for the reference's process-group world
 parallelism is expressed as a named `jax.sharding.Mesh` over which XLA
 compiles ICI/DCN collectives — SURVEY.md §5.8).
 
-Canonical axis names (outer→inner, matching ICI locality — inner axes
+Canonical axis names (outer→inner, matching link locality — inner axes
 get the fastest links):
 
+    dcn_dp — data parallel ACROSS SLICES over DCN (outermost: slowest
+             links carry only the once-per-step gradient all-reduce)
     pp  — pipeline-parallel stage
     dp  — pure data parallel (replicated params)
     fsdp— data parallel with sharded params/optimizer (ZeRO-3 analog)
     sp  — sequence/context parallel (ring attention riders)
     tp  — tensor parallel (megatron-style, innermost, highest traffic)
     ep  — expert parallel for MoE (aliases onto sp/tp block as needed)
+
+Multi-slice: a `dcn_dp > 1` spec builds a HYBRID mesh (the
+`jax.experimental.mesh_utils.create_hybrid_device_mesh` layout): the
+outer axis strides across slices (grouped by `device.slice_index` on
+real multi-slice TPU; contiguous blocks on virtual test meshes) so
+every inner axis stays inside one slice's ICI domain. This replaces
+the reference's multi-node NCCL world (reference:
+train/torch/config.py:115) for cross-slice scale — SURVEY.md §5.8.
 """
 
 from __future__ import annotations
 
 import math
+from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -26,7 +37,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("pp", "dp", "fsdp", "sp", "tp")
+AXES = ("dcn_dp", "pp", "dp", "fsdp", "sp", "tp")
+
+
+def group_by_slice(devices: Sequence) -> List[list]:
+    """Partition devices into slices. Real multi-slice TPU devices
+    carry `slice_index`; single-slice and virtual CPU devices don't
+    (treated as one slice — callers split explicitly for tests)."""
+    groups: Dict[int, list] = defaultdict(list)
+    for d in devices:
+        groups[getattr(d, "slice_index", 0) or 0].append(d)
+    return [groups[k] for k in sorted(groups)]
 
 
 @dataclass(frozen=True)
@@ -39,9 +60,12 @@ class MeshSpec:
     sp: int = 1
     pp: int = 1
     ep: int = 1  # folded into (sp, tp) when building; see build()
+    dcn_dp: int = 1  # data-parallel replicas across slices (DCN)
 
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.pp
+        return (
+            self.dcn_dp * self.dp * self.fsdp * self.tp * self.sp * self.pp
+        )
 
     @staticmethod
     def auto(
@@ -50,15 +74,18 @@ class MeshSpec:
         tp: int = 1,
         sp: int = 1,
         pp: int = 1,
+        dcn_dp: int = 1,
     ) -> "MeshSpec":
         """Fill the fsdp axis with whatever devices remain."""
         n = n_devices if n_devices is not None else len(jax.devices())
-        denom = tp * sp * pp
+        denom = tp * sp * pp * dcn_dp
         if n % denom != 0:
             raise ValueError(
-                f"{n} devices not divisible by tp*sp*pp={denom}"
+                f"{n} devices not divisible by tp*sp*pp*dcn_dp={denom}"
             )
-        return MeshSpec(fsdp=n // denom, tp=tp, sp=sp, pp=pp)
+        return MeshSpec(
+            fsdp=n // denom, tp=tp, sp=sp, pp=pp, dcn_dp=dcn_dp
+        )
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
@@ -67,12 +94,59 @@ class MeshSpec:
             raise ValueError(
                 f"MeshSpec needs {need} devices, have {len(devices)}"
             )
-        shape = (self.pp, self.dp, self.fsdp, self.sp, self.tp)
+        if self.dcn_dp > 1:
+            return self._build_hybrid(devices)
+        shape = (1, self.pp, self.dp, self.fsdp, self.sp, self.tp)
         grid = np.array(devices[:need]).reshape(shape)
+        return Mesh(grid, AXES)
+
+    def _build_hybrid(self, devices: Sequence) -> Mesh:
+        """Hybrid ICI x DCN layout: outer dcn_dp axis = one slice per
+        entry, inner axes laid out within each slice (semantics of
+        mesh_utils.create_hybrid_device_mesh)."""
+        slices = group_by_slice(devices)
+        per_slice = self.num_devices() // self.dcn_dp
+        if len(slices) == 1:
+            # No slice topology reported (virtual CPU mesh, or a
+            # runtime that doesn't expose slice_index): split into
+            # contiguous blocks in (process, device) order, so a
+            # multi-process gang with rank-contiguous slices (what
+            # JaxBackend sets up) keeps each block inside one
+            # process group — high-traffic inner axes never straddle
+            # the process boundary that models DCN.
+            flat = sorted(
+                slices[0],
+                key=lambda d: (
+                    getattr(d, "process_index", 0) or 0,
+                    getattr(d, "id", 0),
+                ),
+            )
+            slices = [
+                flat[i * per_slice : (i + 1) * per_slice]
+                for i in range(self.dcn_dp)
+            ]
+        if len(slices) < self.dcn_dp:
+            raise ValueError(
+                f"dcn_dp={self.dcn_dp} but only {len(slices)} slices"
+            )
+        for group in slices[: self.dcn_dp]:
+            if len(group) < per_slice:
+                raise ValueError(
+                    f"slice contributes {len(group)} devices, "
+                    f"need {per_slice} per slice"
+                )
+        inner = (1, self.pp, self.dp, self.fsdp, self.sp, self.tp)
+        grid = np.stack(
+            [
+                np.array(group[:per_slice]).reshape(inner)[0]
+                for group in slices[: self.dcn_dp]
+            ]
+        )
         return Mesh(grid, AXES)
 
     def axis_sizes(self) -> Dict[str, int]:
         return {
+            "dcn_dp": self.dcn_dp,
             "pp": self.pp,
             "dp": self.dp,
             "fsdp": self.fsdp,
@@ -87,7 +161,7 @@ def single_host_mesh(**axis_sizes) -> Mesh:
 
 def data_axes() -> Tuple[str, ...]:
     """Mesh axes a batch dimension is sharded over."""
-    return ("dp", "fsdp")
+    return ("dcn_dp", "dp", "fsdp")
 
 
 def model_axes() -> Tuple[str, ...]:

@@ -27,8 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Rules = Dict[str, Union[str, Tuple[str, ...], None]]
 
 #: Parameter rules — fsdp shards the embed dim of weights (ZeRO-3).
+#: Params are REPLICATED across dcn_dp (pure DP between slices: the
+#: only cross-slice traffic GSPMD then inserts is the per-step
+#: gradient all-reduce, which is what DCN can afford).
 PARAM_RULES: Rules = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn_dp", "dp", "fsdp"),
     "seq": None,
     "embed": "fsdp",
     "heads": "tp",
@@ -42,7 +45,7 @@ PARAM_RULES: Rules = {
 
 #: Activation rules — batch over data axes, seq over sp, heads over tp.
 ACT_RULES: Rules = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn_dp", "dp", "fsdp"),
     "seq": "sp",
     "embed": None,
     "heads": "tp",

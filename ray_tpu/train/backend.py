@@ -25,26 +25,57 @@ class JaxBackend(Backend):
     """Initializes JAX multi-host coordination across the gang
     (replaces torch dist.init_process_group(backend='nccl'),
     reference train/torch/config.py:115).
+
+    Multi-slice: pass `backend_config={"slices": S}` for a gang that
+    spans S TPU slices. All S*H processes join ONE jax.distributed
+    world (one coordinator — on real multi-slice hardware the
+    cross-slice transport is DCN, reached through the same runtime);
+    each worker additionally learns its slice id (contiguous rank
+    blocks) via RT_SLICE_ID for slice-aware application logic such as
+    per-slice data loading. Mesh construction itself groups by the
+    hardware's `slice_index` (or process boundaries on virtual test
+    meshes — MeshSpec._build_hybrid). Train steps then shard their
+    batch over the hybrid `dcn_dp` axis of `MeshSpec(dcn_dp=S, ...)`
+    — the cross-slice traffic is exactly the per-step gradient
+    all-reduce (SURVEY §5.8; reference analog: the multi-node NCCL
+    world, train/torch/config.py:66-116).
     """
 
     def on_start(self, worker_group, backend_config: dict) -> None:
         coordinator = backend_config.get("coordinator_address")
         num_processes = worker_group.size
+        slices = int(backend_config.get("slices", 1))
+        if num_processes % max(slices, 1) != 0:
+            raise ValueError(
+                f"gang of {num_processes} workers not divisible by "
+                f"slices={slices}"
+            )
         if coordinator is None or num_processes <= 1:
             return
 
-        def _init_jax_distributed(coordinator, num_processes, process_id):
+        def _init_jax_distributed(
+            coordinator, num_processes, process_id, slice_id
+        ):
+            import os
+
             import jax
 
+            os.environ["RT_SLICE_ID"] = str(slice_id)
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=num_processes,
                 process_id=process_id,
             )
 
+        per_slice = num_processes // max(slices, 1)
         worker_group.run_per_rank(
             _init_jax_distributed,
-            lambda rank: (coordinator, num_processes, rank),
+            lambda rank: (
+                coordinator,
+                num_processes,
+                rank,
+                rank // per_slice,
+            ),
         )
 
 

@@ -48,7 +48,7 @@ def test_mesh_too_many_devices():
 
 def test_spec_for_rules():
     assert spec_for(("batch", "seq", None), ACT_RULES) == P(
-        ("dp", "fsdp"), "sp", None
+        ("dcn_dp", "dp", "fsdp"), "sp", None
     )
     assert spec_for(("embed", "mlp"), PARAM_RULES) == P("fsdp", "tp")
 
@@ -165,3 +165,49 @@ class TestCollectives:
         x = jnp.arange(16.0)
         out = grad_norm(w, x)
         assert np.isfinite(np.asarray(out)).all()
+
+
+def test_hybrid_mesh_dcn_dp(devices8):
+    """dcn_dp>1 builds the hybrid layout: outer axis = slices (virtual
+    contiguous blocks off-hardware), inner axes within one slice."""
+    import jax
+
+    mesh = MeshSpec(dcn_dp=2, fsdp=2, tp=2).build()
+    assert mesh.shape["dcn_dp"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tp"] == 2
+    # Slice 0 owns the first 4 devices, slice 1 the last 4.
+    grid = mesh.devices
+    first = {d.id for d in grid[0].flatten()}
+    second = {d.id for d in grid[1].flatten()}
+    assert first == {d.id for d in jax.devices()[:4]}
+    assert second == {d.id for d in jax.devices()[4:8]}
+
+
+def test_hybrid_mesh_too_few_devices():
+    # Trips build()'s generic device-count check before hybrid layout.
+    with pytest.raises(ValueError):
+        MeshSpec(dcn_dp=4, fsdp=1024).build()
+
+
+def test_hybrid_mesh_uneven_slices_rejected():
+    """Real multi-slice topology with too few slices for dcn_dp, and
+    slices that can't cover per-slice demand, both fail loudly."""
+
+    class FakeDev:
+        def __init__(self, slice_index, id):
+            self.slice_index = slice_index
+            self.id = id
+
+    from ray_tpu.parallel.mesh import group_by_slice
+
+    devs = [FakeDev(0, 0), FakeDev(0, 1), FakeDev(1, 2)]
+    groups = group_by_slice(devs)
+    assert [len(g) for g in groups] == [2, 1]
+    spec = MeshSpec(dcn_dp=3, fsdp=1)
+    with pytest.raises(ValueError, match="slices"):
+        spec._build_hybrid(devs)  # 2 slices < dcn_dp=3
+    spec = MeshSpec(dcn_dp=2, fsdp=2)
+    with pytest.raises(ValueError, match="per slice"):
+        # slice 1 contributes 1 device, need 2.
+        spec._build_hybrid(devs + [FakeDev(0, 3)])

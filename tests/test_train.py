@@ -280,3 +280,127 @@ class TestWorkerGroup:
             group.shutdown()
         finally:
             rt.shutdown()
+
+
+class TestMultiSlice:
+    def test_two_slice_gang_hybrid_mesh_matches_single_slice(self):
+        """VERDICT r3 item 2: a 2-worker gang (distinct processes,
+        REAL jax.distributed rendezvous over a coordinator) where each
+        worker models one 4-device slice. The flagship train step runs
+        over the hybrid mesh (outer dcn_dp=2 over DCN, fsdp=4 inside
+        each slice) and its losses must match the single-process flat
+        fsdp=8 mesh — cross-slice pure-dp is mathematically invisible
+        (reference analog: dp over the multi-node NCCL world,
+        train/torch/config.py:66-116)."""
+        import socket
+
+        import ray_tpu as rt
+
+        rt.init(num_cpus=4, ignore_reinit_error=True)
+        try:
+            from ray_tpu.train.backend import JaxBackend
+            from ray_tpu.train.worker_group import WorkerGroup
+
+            group = WorkerGroup(num_workers=2)
+
+            # Stage 1 (before any jax import in the workers): each
+            # worker becomes a virtual 4-device "slice".
+            def setup_env():
+                import os
+
+                os.environ["XLA_FLAGS"] = (
+                    "--xla_force_host_platform_device_count=4"
+                )
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                return os.getpid()
+
+            pids = group.run_all(setup_env)
+            assert pids[0] != pids[1], "gang must span processes"
+
+            # Stage 2: one jax.distributed world across both slices.
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            JaxBackend().on_start(
+                group,
+                {
+                    "coordinator_address": f"127.0.0.1:{port}",
+                    "slices": 2,
+                },
+            )
+
+            def train_two_steps():
+                import os
+
+                import jax
+
+                from ray_tpu.models.llama import (
+                    LlamaConfig,
+                    init_params,
+                    loss_fn,
+                    param_annotations,
+                )
+                from ray_tpu.parallel.mesh import MeshSpec
+                from ray_tpu.train.train_step import (
+                    default_optimizer,
+                    make_train_step,
+                    shard_batch,
+                )
+
+                assert jax.device_count() == 8
+                assert os.environ["RT_SLICE_ID"] in ("0", "1")
+                cfg = LlamaConfig.tiny()
+                mesh = MeshSpec(dcn_dp=2, fsdp=4).build()
+                init_fn, step_fn = make_train_step(
+                    lambda p, t, y: loss_fn(p, t, y, cfg),
+                    default_optimizer(learning_rate=1e-2, total_steps=50),
+                    mesh,
+                    param_annotations(cfg),
+                )
+                state = init_fn(
+                    jax.random.PRNGKey(0), lambda k: init_params(k, cfg)
+                )
+                toks = jax.random.randint(
+                    jax.random.PRNGKey(1), (8, 33), 0, cfg.vocab_size
+                )
+                toks = shard_batch(
+                    toks, mesh, logical_axes=("batch", None)
+                )
+                losses = []
+                for _ in range(2):
+                    state, metrics = step_fn(
+                        state, toks[:, :-1], toks[:, 1:]
+                    )
+                    losses.append(float(metrics["loss"]))
+                return losses
+
+            gang_losses = group.run_all(train_two_steps)
+            assert gang_losses[0] == pytest.approx(gang_losses[1])
+            group.shutdown()
+
+            # Single-process flat fsdp=8 reference on this process's
+            # own 8 virtual devices: same seeds -> same math.
+            cfg = _tiny_cfg()
+            mesh = MeshSpec(fsdp=8).build()
+            init_fn, step_fn = make_train_step(
+                lambda p, t, y: loss_fn(p, t, y, cfg),
+                default_optimizer(learning_rate=1e-2, total_steps=50),
+                mesh,
+                param_annotations(cfg),
+            )
+            state = init_fn(
+                jax.random.PRNGKey(0), lambda k: init_params(k, cfg)
+            )
+            toks = jax.random.randint(
+                jax.random.PRNGKey(1), (8, 33), 0, cfg.vocab_size
+            )
+            toks = shard_batch(toks, mesh, logical_axes=("batch", None))
+            flat_losses = []
+            for _ in range(2):
+                state, metrics = step_fn(state, toks[:, :-1], toks[:, 1:])
+                flat_losses.append(float(metrics["loss"]))
+            assert gang_losses[0] == pytest.approx(
+                flat_losses, abs=2e-3
+            ), f"hybrid {gang_losses[0]} vs flat {flat_losses}"
+        finally:
+            rt.shutdown()
