@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.norms import apply_rotary, rms_norm, rotary_embedding, swiglu
-from .llama import LlamaConfig
+from .llama import LlamaConfig, project_qkv
 
 
 def init_kv_cache(
@@ -60,9 +60,7 @@ def _layer_with_cache(
     b, t, _ = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q, k, v = project_qkv(cfg, h, layer)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
     k_cache = jax.lax.dynamic_update_slice(

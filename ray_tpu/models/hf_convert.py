@@ -1,13 +1,15 @@
-"""HuggingFace Llama checkpoint conversion.
+"""HuggingFace checkpoint conversion (Llama + Qwen2 families).
 
 The integration-parity role of the reference's framework adapters
 (reference: python/ray/train/huggingface/ — Ray Train wraps HF
 Trainer/accelerate; SURVEY §2.3 Train-integrations row): here the
-integration is TPU-first — convert an HF `LlamaForCausalLM` state
-dict into this framework's stacked-scan parameter pytree and run it
-on the JAX/Pallas stack. tests/test_hf_parity.py proves numerical
-parity of the full forward (logits) against transformers' reference
-implementation.
+integration is TPU-first — convert an HF `LlamaForCausalLM` or
+`Qwen2ForCausalLM` state dict into this framework's stacked-scan
+parameter pytree and run it on the JAX/Pallas stack. The two share a
+skeleton (RMSNorm, SwiGLU, rotate-half RoPE, GQA); Qwen2 adds QKV
+projection biases (cfg.attn_bias). tests/test_hf_parity.py proves
+numerical parity of the full forward (logits) against transformers'
+reference implementation for both.
 
 Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
 so activations right-multiply):
@@ -34,9 +36,9 @@ from .llama import LlamaConfig
 
 
 def config_from_hf(hf_config) -> LlamaConfig:
-    """Map a transformers LlamaConfig onto ours. Raises on HF features
-    this model doesn't implement (silent drops would convert cleanly
-    and generate subtly wrong logits)."""
+    """Map a transformers LlamaConfig/Qwen2Config onto ours. Raises on
+    HF features this model doesn't implement (silent drops would
+    convert cleanly and generate subtly wrong logits)."""
     import jax.numpy as jnp
 
     scaling = getattr(hf_config, "rope_scaling", None)
@@ -48,7 +50,33 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "anyway would mis-position every token (Llama-3.1+ "
             "frequency scaling)"
         )
+    model_type = getattr(hf_config, "model_type", "llama")
+    if model_type not in ("llama", "qwen2"):
+        raise NotImplementedError(
+            f"model_type={model_type!r}: only the llama and qwen2 "
+            "families convert; anything else would need its own "
+            "numerics audit"
+        )
+    if getattr(hf_config, "use_sliding_window", False):
+        raise NotImplementedError(
+            "sliding-window attention is not implemented; converting "
+            "would silently change long-context numerics"
+        )
+    # Qwen2 carries QKV biases (and only those). Llama's rare
+    # attention_bias=True variant ALSO biases o_proj — a layout this
+    # model has no slot for, so it stays loudly unsupported. Scoped to
+    # llama: a Qwen2 config.json carrying a (redundant)
+    # attention_bias key must not trip a Llama-specific guard.
+    if model_type == "llama" and getattr(
+        hf_config, "attention_bias", False
+    ):
+        raise NotImplementedError(
+            "llama attention_bias=True (biases on all four attention "
+            "projections incl. o_proj) is unsupported; qwen2-style "
+            "QKV-only biases are the supported biased layout"
+        )
     return LlamaConfig(
+        attn_bias=model_type == "qwen2",
         norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -74,8 +102,8 @@ def _np(tensor) -> np.ndarray:
 
 
 def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
-    """HF LlamaForCausalLM state dict -> our params pytree (layers
-    stacked on axis 0 for lax.scan)."""
+    """HF LlamaForCausalLM / Qwen2ForCausalLM state dict -> our params
+    pytree (layers stacked on axis 0 for lax.scan)."""
     import jax.numpy as jnp
 
     L = cfg.n_layers
@@ -108,6 +136,12 @@ def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
             "post_attention_layernorm.weight", transpose=False
         ),
     }
+    if cfg.attn_bias:  # Qwen2-family QKV biases (1-D: no transpose)
+        layers.update({
+            "bq": stack("self_attn.q_proj.bias", transpose=False),
+            "bk": stack("self_attn.k_proj.bias", transpose=False),
+            "bv": stack("self_attn.v_proj.bias", transpose=False),
+        })
     embed = _np(state_dict["model.embed_tokens.weight"])
     consumed.add("model.embed_tokens.weight")
     if "lm_head.weight" in state_dict:
@@ -141,13 +175,13 @@ def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
 
 
 def load_hf_llama(model) -> Tuple[Dict[str, Any], LlamaConfig]:
-    """From a live transformers LlamaForCausalLM (or a local path
-    loadable by from_pretrained — this hermetic environment has no
-    model hub access, so paths must be local)."""
+    """From a live transformers LlamaForCausalLM/Qwen2ForCausalLM (or
+    a local path loadable by AutoModelForCausalLM — this hermetic
+    environment has no model hub access, so paths must be local)."""
     if isinstance(model, str):
-        from transformers import LlamaForCausalLM
+        from transformers import AutoModelForCausalLM
 
-        model = LlamaForCausalLM.from_pretrained(model)
+        model = AutoModelForCausalLM.from_pretrained(model)
     cfg = config_from_hf(model.config)
     params = convert_hf_llama(model.state_dict(), cfg)
     return params, cfg

@@ -61,6 +61,8 @@ class LlamaConfig:
     moe_aux_weight: float = 0.01
     #: RMSNorm epsilon (HF rms_norm_eps; Llama-2 ships 1e-5).
     norm_eps: float = 1e-6
+    #: Attention QKV projection biases (Qwen2-family; Llama has none).
+    attn_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -81,6 +83,10 @@ class LlamaConfig:
             + ffn
             + 2 * self.dim  # norms
         )
+        if self.attn_bias:
+            per_layer += (
+                self.n_heads + 2 * self.n_kv_heads
+            ) * self.head_dim
         return embed * 2 + self.n_layers * per_layer + self.dim
 
     # ---- presets ----
@@ -140,6 +146,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         "attn_norm": jnp.ones((L, cfg.dim), dt),
         "mlp_norm": jnp.ones((L, cfg.dim), dt),
     }
+    if cfg.attn_bias:
+        layers.update({
+            "bq": jnp.zeros((L, cfg.n_heads * hd), dt),
+            "bk": jnp.zeros((L, cfg.n_kv_heads * hd), dt),
+            "bv": jnp.zeros((L, cfg.n_kv_heads * hd), dt),
+        })
     if cfg.moe_experts:
         E = cfg.moe_experts
         layers.update({
@@ -178,6 +190,12 @@ def param_annotations(cfg: LlamaConfig) -> Dict[str, Any]:
         "attn_norm": annotate("layers", None),
         "mlp_norm": annotate("layers", None),
     }
+    if cfg.attn_bias:
+        layers.update({
+            "bq": annotate("layers", "heads"),
+            "bk": annotate("layers", "kv_heads"),
+            "bv": annotate("layers", "kv_heads"),
+        })
     if cfg.moe_experts:
         layers.update({
             "router": annotate("layers", "embed", None),
@@ -198,6 +216,22 @@ def param_annotations(cfg: LlamaConfig) -> Dict[str, Any]:
     }
 
 
+def project_qkv(cfg: LlamaConfig, h, layer):
+    """Shared QKV projection (+ Qwen2-family biases) and head split —
+    the training layer and the KV-cache serving layer must use the
+    SAME projection or their logits silently diverge.
+    h: [b, t, dim] -> each of q/k/v: [b, heads, t, head_dim]."""
+    b, t, _ = h.shape
+    hd = cfg.head_dim
+    q, k, v = h @ layer["wq"], h @ layer["wk"], h @ layer["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
 def _attention(cfg: LlamaConfig, q, k, v, sp_axis: Optional[str]):
     k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
     v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
@@ -215,9 +249,7 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
     b, t, _ = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q, k, v = project_qkv(cfg, h, layer)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
     attn = _attention(cfg, q, k, v, sp_axis)

@@ -95,17 +95,6 @@ def test_llama2_style_eps_respected():
 
 def test_unsupported_checkpoint_features_fail_loudly():
     from transformers import LlamaConfig as HFConfig
-    from transformers import LlamaForCausalLM
-
-    biased = LlamaForCausalLM(HFConfig(
-        vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=1, num_attention_heads=2,
-        num_key_value_heads=2, attention_bias=True,
-        tie_word_embeddings=False,
-    ))
-    cfg = config_from_hf(biased.config)
-    with pytest.raises(ValueError, match="unconverted"):
-        convert_hf_llama(biased.state_dict(), cfg)
 
     scaled = HFConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
@@ -115,6 +104,100 @@ def test_unsupported_checkpoint_features_fail_loudly():
     )
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(scaled)
+
+    class FakeConfig:
+        model_type = "gpt_bigcode"
+        rope_scaling = None
+
+    with pytest.raises(NotImplementedError, match="model_type"):
+        config_from_hf(FakeConfig())
+
+
+def _tiny_hf_qwen2(n_heads=4, n_kv_heads=4, seed=0, tied=False):
+    """Qwen2: same skeleton as Llama plus QKV projection biases — the
+    second HF architecture (VERDICT r3 item 10), proving the converter
+    isn't Llama-shape-hardcoded."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_kv_heads,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        tie_word_embeddings=tied,
+        use_sliding_window=False,
+        attn_implementation="eager",
+    )
+    model = Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_qwen2_logits_match_transformers_mha():
+    model = _tiny_hf_qwen2(n_heads=4, n_kv_heads=4, seed=7)
+    cfg = config_from_hf(model.config)
+    assert cfg.attn_bias  # qwen2 always carries QKV biases
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 128, (2, 33), dtype=np.int64)
+    _compare(model, tokens)
+
+
+def test_qwen2_logits_match_transformers_gqa_tied():
+    """GQA + tied embeddings (how small Qwen2 checkpoints ship)."""
+    model = _tiny_hf_qwen2(n_heads=8, n_kv_heads=2, seed=8, tied=True)
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, 128, (1, 48), dtype=np.int64)
+    _compare(model, tokens)
+
+
+def test_qwen2_greedy_decode_matches_transformers_generate():
+    """The KV-cache serving path applies the biases too."""
+    from ray_tpu.models.generate import generate
+
+    model = _tiny_hf_qwen2(n_heads=4, n_kv_heads=2, seed=9)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 128, (2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt),
+            max_new_tokens=10,
+            do_sample=False,
+            pad_token_id=0,
+            eos_token_id=None,
+        )[:, prompt.shape[1]:].numpy()
+    cfg = config_from_hf(model.config)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    ours, _lengths = generate(
+        params,
+        jax.numpy.asarray(prompt),
+        jax.numpy.asarray(np.full(2, prompt.shape[1], np.int32)),
+        cfg,
+        max_new_tokens=10,
+        temperature=0.0,
+    )
+    assert np.asarray(ours).tolist() == ref.tolist()
+
+
+def test_biased_llama_rejected_loudly():
+    """Llama attention_bias=True biases ALL FOUR projections (incl.
+    o_proj) — no slot here, so it must fail at config time, not
+    convert into a numerically different model. (QKV-only biases are
+    the supported biased layout — the Qwen2 tests above.)"""
+    from transformers import LlamaConfig as HFConfig
+
+    biased = HFConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, attention_bias=True,
+        tie_word_embeddings=False,
+    )
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        config_from_hf(biased)
 
 
 def test_flash_attention_matches_hf_reference():
