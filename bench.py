@@ -193,6 +193,98 @@ def run_train_bench(tpu: bool) -> dict:
     }
 
 
+def run_7b_layer_bench() -> dict:
+    """7B-shape MFU evidence on one chip (VERDICT r3 item 8): train
+    steps at the EXACT Llama-2-7B layer geometry (dim 4096, 32 heads,
+    intermediate 11008, seq 4096 — BASELINE.json north-star config) on
+    2- and 4-layer stacks; two-point extrapolation separates per-layer
+    time from fixed (embed/lm_head/data) cost and projects the
+    32-layer whole-model MFU. A full 7B doesn't fit one 16-GiB v5e
+    chip — this measures the same kernels at the same shapes on the
+    hardware that exists."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import (
+        LlamaConfig,
+        flops_per_token,
+        init_params,
+        loss_fn,
+        param_annotations,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train.train_step import (
+        default_optimizer,
+        make_train_step,
+        shard_batch,
+    )
+
+    assert jax.default_backend() != "cpu", "7b-layer bench needs the chip"
+    batch, seq = 2, 4096
+    steps, warmup = 5, 2
+
+    def cfg_layers(n_layers: int) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=32000, dim=4096, n_layers=n_layers, n_heads=32,
+            n_kv_heads=32, intermediate=11008, max_seq_len=seq,
+            dtype=jnp.bfloat16, attention="flash", remat_policy="dots",
+        )
+
+    mesh = MeshSpec(fsdp=len(jax.devices())).build()
+    optimizer = default_optimizer(total_steps=100000)
+    step_time = {}
+    for n_layers in (2, 4):
+        cfg = cfg_layers(n_layers)
+
+        def loss(params, tokens, targets, _cfg=cfg):
+            return loss_fn(params, tokens, targets, _cfg)
+
+        init_fn, step_fn = make_train_step(
+            loss, optimizer, mesh, param_annotations(cfg)
+        )
+        state = init_fn(
+            jax.random.PRNGKey(0), lambda k, _cfg=cfg: init_params(k, _cfg)
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
+        )
+        tokens = shard_batch(tokens, mesh, logical_axes=("batch", None))
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        for _ in range(warmup):
+            state, metrics = step_fn(state, inp, tgt)
+        float(metrics["loss"])  # sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, inp, tgt)
+        final_loss = float(metrics["loss"])  # sync
+        step_time[n_layers] = (time.perf_counter() - t0) / steps
+        assert final_loss == final_loss and final_loss > 0, final_loss
+        # Free the stack's HBM before the next (bigger) one compiles.
+        del state, step_fn, init_fn, tokens, inp, tgt
+        gc.collect()
+
+    t_layer = (step_time[4] - step_time[2]) / 2
+    t_fixed = max(step_time[2] - 2 * t_layer, 0.0)
+    t_32 = t_fixed + 32 * t_layer
+    cfg32 = cfg_layers(32)
+    # Per-chip normalization (like run_train_bench): t_32 is wall time
+    # across ALL local chips in the fsdp mesh.
+    tokens_per_s = batch * seq / t_32 / len(jax.devices())
+    mfu = flops_per_token(cfg32, seq) * tokens_per_s / peak_flops_per_chip()
+    return {
+        "mfu_7b_layer_projection": round(mfu, 4),
+        "tokens_per_sec_7b_projected": round(tokens_per_s, 1),
+        "layer_ms": round(t_layer * 1e3, 2),
+        "fixed_ms": round(t_fixed * 1e3, 2),
+        "step_ms_2l": round(step_time[2] * 1e3, 1),
+        "step_ms_4l": round(step_time[4] * 1e3, 1),
+        "batch": batch,
+        "seq": seq,
+    }
+
+
 # ---------------------------------------------------------------------------
 # op/s microbenchmarks (reference: ray_perf.py cases)
 # ---------------------------------------------------------------------------
@@ -418,7 +510,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--mode",
-        choices=["orchestrate", "tpu", "cpu", "micro"],
+        choices=["orchestrate", "tpu", "tpu7b", "cpu", "micro"],
         default="orchestrate",
     )
     parser.add_argument(
@@ -429,6 +521,9 @@ def main() -> None:
 
     if args.mode == "tpu":
         print(json.dumps(run_train_bench(tpu=True)))
+        return
+    if args.mode == "tpu7b":
+        print(json.dumps(run_7b_layer_bench()))
         return
     if args.mode == "cpu":
         result = run_train_bench(tpu=False)
@@ -489,6 +584,23 @@ def main() -> None:
             "error": "both TPU and CPU benchmark subprocesses failed",
         }
     _write_partial(result)
+
+    # 7B-layer-geometry MFU projection — only after the main TPU
+    # bench actually reached the chip (not after cpu_fallback, and not
+    # after the both-benches-failed error dict: the chip is dead).
+    if (
+        not result.get("cpu_fallback")
+        and "error" not in result
+        and remaining() > 240.0
+    ):
+        seven_b = _run_mode_subprocess(
+            "tpu7b", min(420.0, remaining() - 120.0)
+        )
+        if seven_b is not None:
+            result["7b_layer"] = seven_b
+        else:
+            result["7b_layer_error"] = "tpu7b subprocess failed/timed out"
+        _write_partial(result)
 
     if not args.skip_micro and remaining() > 30.0:
         micro = _run_mode_subprocess(
