@@ -5,6 +5,7 @@ from .api import (
     get_app_handle,
     run,
     shutdown,
+    proxy_ports,
     start,
     status,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "AutoscalingConfig",
     "batch",
     "run",
+    "proxy_ports",
     "start",
     "status",
     "delete",

@@ -8,6 +8,7 @@ brings up HTTP ingress; status/delete/shutdown manage lifecycle.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -36,8 +37,15 @@ def _get_or_create_controller():
         return rt.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
     except ValueError:
         pass
+    # Long-poll listeners (one per router/proxy) BLOCK inside
+    # listen_for_change; the controller must run them on a wide
+    # thread pool or one parked listener starves every control call
+    # (reference: the controller is an async actor).
     actor_cls = rt.remote(
-        num_cpus=0, name=CONTROLLER_NAME, namespace=_NAMESPACE
+        num_cpus=0,
+        name=CONTROLLER_NAME,
+        namespace=_NAMESPACE,
+        max_concurrency=64,
     )(ServeController)
     handle = actor_cls.remote()
     # Touch it so creation completed before anyone races lookups.
@@ -82,6 +90,12 @@ def _build_specs(app: Application, app_name: str):
                 "version": dep.version,
                 "batched_methods": batched,
                 "ingress": bound is flat[-1],
+                # Generator __call__ => the proxy streams the response
+                # out as chunked transfer-encoding (reference: serve
+                # supports generator deployments for streaming).
+                "ingress_streaming": inspect.isgeneratorfunction(
+                    getattr(dep.underlying, "__call__", None)
+                ),
             }
         )
     return specs
@@ -103,19 +117,79 @@ def run(
     return DeploymentHandle(name, app.deployment.name)
 
 
-def start(http_port: int = 8000) -> int:
-    """Start the HTTP proxy; returns the bound port (reference:
-    serve.start + ProxyActor per node)."""
+def start(
+    http_port: int = 8000,
+    per_node: bool = True,
+    http_host: str = "127.0.0.1",
+) -> int:
+    """Start HTTP proxies — one per alive node, each pinned with node
+    affinity and routing to LOCAL replicas first (reference:
+    serve.start + proxy_state.py per-node ProxyActors). Returns the
+    port of this node's proxy. Pass http_host="0.0.0.0" on a real
+    multi-host cluster so every node's proxy is reachable from
+    outside its host. On in-box test clusters (all daemons on one
+    host) the extra proxies take ephemeral ports when http_port is
+    already bound; query them via `proxy_ports()`. The LOCAL proxy
+    never silently rebinds — a port conflict on this node raises."""
+    from ..util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
     rt = _rt()
     _get_or_create_controller()
-    try:
-        proxy = rt.get_actor(PROXY_NAME, namespace=_NAMESPACE)
-    except ValueError:
-        actor_cls = rt.remote(
-            num_cpus=0, name=PROXY_NAME, namespace=_NAMESPACE
-        )(Proxy)
-        proxy = actor_cls.remote(http_port)
-    return rt.get(proxy.ready.remote(), timeout=60)
+    local_node = rt.get_runtime_context().get_node_id()
+    node_ids = (
+        [n["node_id"] for n in rt.nodes() if n.get("alive")]
+        if per_node
+        else [local_node]
+    )
+    local_port = None
+    for node_id in node_ids:
+        name = (
+            PROXY_NAME
+            if node_id == local_node
+            else f"{PROXY_NAME}:{node_id[:12]}"
+        )
+        try:
+            proxy = rt.get_actor(name, namespace=_NAMESPACE)
+        except ValueError:
+            actor_cls = rt.remote(
+                num_cpus=0,
+                name=name,
+                namespace=_NAMESPACE,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_id
+                ),
+            )(Proxy)
+            proxy = actor_cls.remote(
+                http_port,
+                node_id != local_node,  # extras may take ephemeral
+                http_host,
+            )
+        port = rt.get(proxy.ready.remote(), timeout=60)
+        if node_id == local_node:
+            local_port = port
+    return local_port if local_port is not None else http_port
+
+
+def proxy_ports() -> Dict[str, int]:
+    """node_id -> bound proxy port for every running proxy."""
+    rt = _rt()
+    out: Dict[str, int] = {}
+    local_node = rt.get_runtime_context().get_node_id()
+    for node in rt.nodes():
+        node_id = node["node_id"]
+        name = (
+            PROXY_NAME
+            if node_id == local_node
+            else f"{PROXY_NAME}:{node_id[:12]}"
+        )
+        try:
+            proxy = rt.get_actor(name, namespace=_NAMESPACE)
+            out[node_id] = rt.get(proxy.ready.remote(), timeout=30)
+        except Exception:
+            continue
+    return out
 
 
 def status() -> Dict[str, Any]:
@@ -145,7 +219,12 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
+    from . import router as _router
+
     rt = _rt()
+    # Stop this process's long-poll listener threads (new handles
+    # created by a later deploy start fresh listeners).
+    _router.notify_shutdown()
     try:
         controller = rt.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
     except ValueError:
@@ -154,12 +233,24 @@ def shutdown() -> None:
         rt.get(controller.shutdown_all.remote(), timeout=60)
     except Exception:
         pass
+    # Kill every per-node proxy (local name + node-suffixed names).
+    names = [PROXY_NAME]
     try:
-        proxy = rt.get_actor(PROXY_NAME, namespace=_NAMESPACE)
-        rt.get(proxy.stop.remote(), timeout=10)
-        rt.kill(proxy)
+        local_node = rt.get_runtime_context().get_node_id()
+        names += [
+            f"{PROXY_NAME}:{n['node_id'][:12]}"
+            for n in rt.nodes()
+            if n["node_id"] != local_node
+        ]
     except Exception:
         pass
+    for name in names:
+        try:
+            proxy = rt.get_actor(name, namespace=_NAMESPACE)
+            rt.get(proxy.stop.remote(), timeout=10)
+            rt.kill(proxy)
+        except Exception:
+            continue
     try:
         rt.kill(controller)
     except Exception:

@@ -4,8 +4,9 @@ Reference: python/ray/serve/_private/controller.py:84 — a singleton
 controller reconciles declared application/deployment state against
 live replica actors (deployment_state.py), autoscales on reported
 ongoing-request load (autoscaling_state.py), and serves route +
-replica-membership lookups to routers/proxies (long_poll.py is
-approximated by short-TTL polling).
+replica-membership lookups to routers/proxies via LONG-POLL PUSH
+(long_poll.py LongPollHost: listeners block on a snapshot id and are
+released the moment state changes — no TTL staleness window).
 """
 
 from __future__ import annotations
@@ -16,6 +17,9 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+#: Server-side cap on one long-poll blocking call; listeners loop.
+LONG_POLL_TIMEOUT_S = 30.0
 
 
 class ServeController:
@@ -33,6 +37,12 @@ class ServeController:
         self._desired_since: Dict[Tuple[str, str], tuple] = {}
         self._replica_seq = 0
         self._shutdown = False
+        # Long-poll host state (reference: long_poll.py LongPollHost):
+        # every pushable key has a monotonically increasing snapshot
+        # id; listeners block on the condvar until a key they watch
+        # moves past the id they already have.
+        self._snapshot_ids: Dict[str, int] = {}
+        self._longpoll_cv = threading.Condition(self._lock)
         self._autoscaler = threading.Thread(
             target=self._autoscale_loop, daemon=True
         )
@@ -51,6 +61,10 @@ class ServeController:
             }
         for spec in specs:
             self._reconcile_deployment(app_name, spec)
+        self._bump(
+            "routes",
+            *(f"spec:{app_name}/{s['name']}" for s in specs),
+        )
         return True
 
     def _reconcile_deployment(self, app: str, spec: dict) -> None:
@@ -66,6 +80,8 @@ class ServeController:
             self._replicas[key] = keep
         for replica in stale:
             self._stop_replica(replica)
+        if stale:
+            self._bump(f"replicas:{app}/{spec['name']}")
         target = spec["num_replicas"]
         if spec.get("autoscaling"):
             target = max(
@@ -87,6 +103,8 @@ class ServeController:
             if excess is not None:
                 for replica in excess:
                     self._stop_replica(replica)
+                if excess:
+                    self._bump(f"replicas:{app}/{spec['name']}")
                 return
             self._start_replica(app, spec)
 
@@ -114,22 +132,82 @@ class ServeController:
             spec["init_kwargs"],
             replica_id,
         )
-        # Block until the replica's constructor ran (readiness probe).
-        self._rt.get(handle.ping.remote(), timeout=60)
+        # Block until the replica's constructor ran (readiness probe);
+        # it reports its node so routers can prefer local replicas.
+        node_id = self._rt.get(handle.node_id.remote(), timeout=60)
         with self._lock:
             self._replicas[(app, spec["name"])].append(
                 {
                     "id": replica_id,
                     "actor": handle,
                     "version": spec["version"],
+                    "node_id": node_id,
                 }
             )
+        self._bump(f"replicas:{app}/{spec['name']}")
 
     def _stop_replica(self, replica: dict) -> None:
         try:
             self._rt.kill(replica["actor"])
         except Exception:
             pass
+
+    # -- long poll -----------------------------------------------------
+    def _bump(self, *keys: str) -> None:
+        """Advance snapshot ids and release blocked listeners (caller
+        need not hold the lock)."""
+        with self._longpoll_cv:
+            for key in keys:
+                self._snapshot_ids[key] = (
+                    self._snapshot_ids.get(key, 0) + 1
+                )
+            self._longpoll_cv.notify_all()
+
+    def _snapshot_value(self, key: str):
+        if key == "routes":
+            return self.get_routes()
+        kind, _, rest = key.partition(":")
+        if kind == "replicas":
+            app, _, dep = rest.partition("/")
+            return self.get_replicas(app, dep)
+        if kind == "spec":
+            app, _, dep = rest.partition("/")
+            try:
+                return self.get_deployment_spec(app, dep)
+            except KeyError:
+                return None
+        raise ValueError(f"unknown long-poll key {key!r}")
+
+    def listen_for_change(self, watched: Dict[str, int]) -> dict:
+        """Block until any watched key's snapshot id exceeds the
+        caller's, then return {key: {"snapshot_id", "value"}} for the
+        changed keys; {} on server-side timeout (caller re-arms).
+        Runs on the controller's thread pool (max_concurrency), so
+        many routers/proxies can hold open polls concurrently
+        (reference: long_poll.py LongPollHost.listen_for_change)."""
+        deadline = time.time() + LONG_POLL_TIMEOUT_S
+        with self._longpoll_cv:
+            while not self._shutdown:
+                changed = {
+                    key: seen
+                    for key, seen in watched.items()
+                    if self._snapshot_ids.get(key, 0) > seen
+                }
+                if changed:
+                    break
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {}
+                self._longpoll_cv.wait(timeout=remaining)
+            if self._shutdown:
+                return {}
+            out = {}
+            for key in changed:
+                out[key] = {
+                    "snapshot_id": self._snapshot_ids.get(key, 0),
+                    "value": self._snapshot_value(key),
+                }
+            return out
 
     # -- lookups -------------------------------------------------------
     def get_routes(self) -> Dict[str, Tuple[str, str]]:
@@ -143,7 +221,11 @@ class ServeController:
     def get_replicas(self, app: str, deployment: str) -> List[dict]:
         with self._lock:
             return [
-                {"id": r["id"], "actor": r["actor"]}
+                {
+                    "id": r["id"],
+                    "actor": r["actor"],
+                    "node_id": r.get("node_id"),
+                }
                 for r in self._replicas.get((app, deployment), [])
             ]
 
@@ -151,13 +233,14 @@ class ServeController:
         with self._lock:
             spec = self._apps[app]["deployments"][deployment]
             return {
-                k: spec[k]
+                k: spec.get(k)
                 for k in (
                     "name",
                     "num_replicas",
                     "version",
                     "batched_methods",
                     "autoscaling",
+                    "ingress_streaming",
                 )
             }
 
@@ -254,6 +337,9 @@ class ServeController:
                 doomed.extend(self._replicas.pop(key, []))
         for replica in doomed:
             self._stop_replica(replica)
+        self._bump(
+            "routes", *(f"replicas:{app}/{dep}" for app, dep in keys)
+        )
         return True
 
     def shutdown_all(self) -> bool:
@@ -262,4 +348,6 @@ class ServeController:
         for app in apps:
             self.delete_app(app)
         self._shutdown = True
+        with self._longpoll_cv:  # release all blocked listeners
+            self._longpoll_cv.notify_all()
         return True

@@ -2,10 +2,16 @@
 
 Reference: python/ray/serve/_private/proxy.py:1135 — a per-node proxy
 actor terminates HTTP and routes by path prefix to the application's
-ingress deployment. The reference runs uvicorn/starlette (ASGI); here
-a stdlib ThreadingHTTPServer thread inside the proxy actor serves the
-same role, and the request surface handed to the ingress __call__ is a
-small Request object (method/path/query/headers/body/json).
+ingress deployment; serve.start() places one proxy on EVERY alive
+node (reference: proxy_state.py per-node proxies), and each proxy's
+routers prefer replicas on their own node. The reference runs
+uvicorn/starlette (ASGI); here a stdlib ThreadingHTTPServer thread
+inside the proxy actor serves the same role, and the request surface
+handed to the ingress __call__ is a small Request object
+(method/path/query/headers/body/json). Route changes arrive by
+controller long-poll push (reference: long_poll.py), and generator
+ingresses stream out as chunked transfer-encoding — token N is on the
+wire while the replica computes token N+1.
 """
 
 from __future__ import annotations
@@ -48,7 +54,12 @@ class Proxy:
     """Proxy actor body: serves HTTP on `port`, routes to ingress
     handles via longest-prefix match."""
 
-    def __init__(self, port: int):
+    def __init__(
+        self,
+        port: int,
+        fallback_ephemeral: bool = True,
+        host: str = "127.0.0.1",
+    ):
         self.port = port
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._routes_ts = 0.0
@@ -63,11 +74,16 @@ class Proxy:
 
             def _serve(self):
                 try:
-                    status, payload, ctype = proxy._dispatch(self)
+                    result = proxy._dispatch(self)
                 except Exception as e:  # noqa: BLE001 — 500 surface
-                    status = 500
-                    payload = json.dumps({"error": repr(e)}).encode()
-                    ctype = "application/json"
+                    result = (
+                        500,
+                        json.dumps({"error": repr(e)}).encode(),
+                        "application/json",
+                    )
+                if result is None:
+                    return  # response already streamed
+                status, payload, ctype = result
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
@@ -76,11 +92,28 @@ class Proxy:
 
             do_GET = do_POST = do_PUT = do_DELETE = _serve
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        import errno
+
+        try:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+        except OSError as e:
+            if not fallback_ephemeral or e.errno != errno.EADDRINUSE:
+                raise  # real bind failures must surface to the user
+            # In-box multi-daemon clusters share one host: per-node
+            # proxies can't all bind the same port there, so extras
+            # take an ephemeral one (real multi-host nodes each bind
+            # the configured port).
+            self._server = ThreadingHTTPServer((host, 0), Handler)
+        self.port = self._server.server_address[1]  # resolve port=0
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+        self._listener = threading.Thread(
+            target=self._routes_listen_loop, daemon=True,
+            name="serve-proxy-longpoll",
+        )
+        self._listener.start()
 
     # -- routing -------------------------------------------------------
     def _refresh_routes(self, force: bool = False) -> None:
@@ -88,13 +121,41 @@ class Proxy:
 
         from .controller import CONTROLLER_NAME
 
-        if not force and time.time() - self._routes_ts < 2.0:
+        if self._routes_ts and not force:
             return
         controller = rt.get_actor(CONTROLLER_NAME, namespace="serve")
         self._routes = rt.get(
             controller.get_routes.remote(), timeout=30
         )
         self._routes_ts = time.time()
+
+    def _routes_listen_loop(self) -> None:
+        """Route-table push (reference: proxy long-polls route_table
+        through long_poll.py)."""
+        import ray_tpu as rt
+
+        from .controller import CONTROLLER_NAME
+
+        keys = {"routes": 0}
+        while True:
+            try:
+                controller = rt.get_actor(
+                    CONTROLLER_NAME, namespace="serve"
+                )
+                changed = rt.get(
+                    controller.listen_for_change.remote(dict(keys)),
+                    timeout=60,
+                )
+            except Exception:
+                time.sleep(0.2)
+                continue
+            if not changed:
+                continue
+            update = changed.get("routes")
+            if update is not None:
+                keys["routes"] = update["snapshot_id"]
+                self._routes = update["value"] or {}
+                self._routes_ts = time.time()
 
     def _match(self, path: str):
         best = None
@@ -136,7 +197,17 @@ class Proxy:
             headers=dict(handler.headers.items()),
             body=body,
         )
-        value = self._handles[key].remote(request).result(timeout=60)
+        handle = self._handles[key]
+        handle._refresh()
+        with handle._lock:
+            streaming = bool(
+                (handle._state["spec"] or {}).get("ingress_streaming")
+            )
+        if streaming:
+            chunks = handle.options(stream=True).remote(request)
+            self._stream_response(handler, chunks)
+            return None
+        value = handle.remote(request).result(timeout=60)
         if isinstance(value, bytes):
             return 200, value, "application/octet-stream"
         if isinstance(value, str):
@@ -146,6 +217,43 @@ class Proxy:
             json.dumps(value, default=str).encode(),
             "application/json",
         )
+
+    def _stream_response(self, handler, chunks) -> None:
+        """Chunked transfer-encoding: each replica yield goes on the
+        wire immediately (reference: proxy.py streaming ASGI
+        responses for generator deployments — LLM token output)."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/plain; charset=utf-8")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        # Once the 200 header is out, NOTHING may escape this method:
+        # a propagated exception would make the outer handler write a
+        # second (500) response onto the same keep-alive connection,
+        # desynchronizing the next request. Mid-stream errors end the
+        # chunk stream early — the HTTP-correct failure surface.
+        try:
+            try:
+                for chunk in chunks:
+                    data = (
+                        chunk
+                        if isinstance(chunk, bytes)
+                        else str(chunk).encode()
+                    )
+                    if not data:
+                        continue
+                    handler.wfile.write(
+                        f"{len(data):X}\r\n".encode() + data + b"\r\n"
+                    )
+                    handler.wfile.flush()
+            finally:
+                # Releases the router's ongoing-count slot even when
+                # the client disconnected mid-stream.
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    close()
+                handler.wfile.write(b"0\r\n\r\n")
+        except Exception:
+            pass
 
     def ready(self) -> int:
         return self.port

@@ -64,6 +64,29 @@ class Replica:
             return target(*args, **kwargs)
         return target(*args, **kwargs)
 
+    def handle_request_streaming(
+        self, method: str, args: tuple, kwargs: dict
+    ):
+        """Generator variant: the user method must yield chunks; each
+        yield ships to the caller immediately over the runtime's
+        streaming-generator transport (reference: replica.py
+        handle_request_streaming + StreamingObjectRefGenerator).
+        Called with num_returns='streaming' by the router."""
+        with self._served_lock:
+            self._served += 1
+        target = (
+            self._instance
+            if method == "__call__"
+            else getattr(self._instance, method)
+        )
+        yield from target(*args, **kwargs)
+
+    def node_id(self) -> str:
+        """This replica's node (routers prefer local replicas)."""
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
     def handle_batch(self, method: str, batched_args: list):
         """One call carrying many requests; the user method receives
         the list (reference: serve/batching.py _BatchQueue)."""

@@ -2,10 +2,15 @@
 
 Reference: python/ray/serve/handle.py (DeploymentHandle /
 DeploymentResponse) and _private/replica_scheduler/pow_2_scheduler.py:52
-— pick two random replicas, send to the one with fewer ongoing
-requests tracked by this router. Batched methods group concurrent
-calls handle-side into one replica call (reference: serve/batching.py,
-relocated to the router because replicas execute serially here).
+— pick two random replicas (preferring replicas on THIS node, the
+reference's locality-aware candidate selection), send to the one with
+fewer ongoing requests tracked by this router. Replica membership and
+deployment specs arrive by CONTROLLER PUSH over a long-poll listener
+(reference: long_poll.py LongPollClient) — a redeploy is visible here
+within one push round-trip, not a cache-TTL window. Batched methods
+group concurrent calls handle-side into one replica call (reference:
+serve/batching.py, relocated to the router because replicas execute
+serially here).
 """
 
 from __future__ import annotations
@@ -18,13 +23,31 @@ from typing import Any, Dict, List, Optional
 
 from .controller import CONTROLLER_NAME
 
-_REPLICA_CACHE_TTL = 1.0
-
 
 def _controller():
     import ray_tpu as rt
 
     return rt.get_actor(CONTROLLER_NAME, namespace="serve")
+
+
+#: Bumped by serve.shutdown(): long-poll listener threads exit when
+#: their start-time epoch is stale instead of retrying a dead
+#: controller at 5 Hz forever.
+_shutdown_epoch = 0
+
+
+def notify_shutdown() -> None:
+    global _shutdown_epoch
+    _shutdown_epoch += 1
+
+
+def _local_node_id() -> Optional[str]:
+    try:
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+    except Exception:
+        return None
 
 
 class DeploymentResponse:
@@ -49,6 +72,47 @@ class DeploymentResponse:
         if isinstance(self._value, BaseException):
             raise self._value
         return self._value
+
+
+class DeploymentResponseGenerator:
+    """Iterator over a streaming replica method's yields (reference:
+    handle.py DeploymentResponseGenerator). Chunks arrive as the
+    replica produces them — the transport is the runtime's streaming
+    generator path, so a slow consumer doesn't buffer the whole
+    response anywhere."""
+
+    def __init__(self, ref_gen, router: "DeploymentHandle", replica_id):
+        self._gen = ref_gen
+        self._router = router
+        self._replica_id = replica_id
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu as rt
+
+        if self._finished:
+            raise StopIteration
+        try:
+            ref = next(self._gen)
+            return rt.get(ref, timeout=60)
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        """Release the ongoing-count slot exactly once. Abandoning the
+        iterator mid-stream (client disconnect, break) without close()
+        would leave phantom in-flight load skewing pow-2 routing and
+        pinning the autoscaler up forever."""
+        if not self._finished:
+            self._finished = True
+            self._router._ongoing_done(self._replica_id)
+
+    def __del__(self):
+        self.close()
 
 
 class _BatchQueue:
@@ -137,25 +201,34 @@ class DeploymentHandle:
         self._method = method_name
         self._handle_id = uuid.uuid4().hex[:8]
         self._lock = threading.Lock()
-        self._replicas: List[dict] = []
-        self._replicas_ts = 0.0
-        self._spec: Optional[dict] = None
+        # Replica membership + spec live in a SHARED mutable box so
+        # every method clone of this handle family sees long-poll
+        # pushes (clone-time attribute snapshots would strand clones
+        # on killed replicas after a redeploy).
+        self._state: Dict[str, Any] = {
+            "replicas": [],
+            "replicas_ts": 0.0,
+            "spec": None,
+        }
         self._ongoing: Dict[str, int] = {}  # replica_id -> in flight
         self._sent = 0
         self._done = 0
         self._batchers: Dict[str, _BatchQueue] = {}
         self._reporter: Optional[threading.Thread] = None
+        # Mutable box shared across method clones (plain attributes
+        # would be snapshotted at clone time): one listener per
+        # handle family.
+        self._listener_box: Dict[str, Any] = {"thread": None}
+        self._stream = False
 
     # -- routing -------------------------------------------------------
     def _refresh(self, force: bool = False) -> None:
-        now = time.time()
+        """Pull the current snapshot once, then keep it current by
+        long-poll PUSH (the listener thread below)."""
         with self._lock:
-            fresh = (
-                not force
-                and self._replicas
-                and now - self._replicas_ts < _REPLICA_CACHE_TTL
-            )
+            fresh = bool(self._state["replicas_ts"]) and not force
         if fresh:
+            self._ensure_listener()
             return
         import ray_tpu as rt
 
@@ -173,16 +246,63 @@ class DeploymentHandle:
             timeout=30,
         )
         with self._lock:
-            self._replicas = replicas
-            self._replicas_ts = now
-            self._spec = spec
+            self._state["replicas"] = replicas
+            self._state["replicas_ts"] = time.time()
+            self._state["spec"] = spec
+        self._ensure_listener()
+
+    def _ensure_listener(self) -> None:
+        with self._lock:
+            if self._listener_box["thread"] is not None:
+                return
+            self._listener_box["thread"] = threading.Thread(
+                target=self._listen_loop, daemon=True,
+                name=f"serve-longpoll:{self.deployment_name}",
+            )
+            self._listener_box["thread"].start()
+
+    def _listen_loop(self) -> None:
+        """Long-poll client (reference: long_poll.py LongPollClient):
+        each round blocks controller-side until replicas or spec
+        change, then applies the pushed values."""
+        import ray_tpu as rt
+
+        dep = f"{self.app_name}/{self.deployment_name}"
+        keys = {f"replicas:{dep}": 0, f"spec:{dep}": 0}
+        epoch = _shutdown_epoch
+        backoff = 0.2
+        while epoch == _shutdown_epoch:
+            try:
+                controller = _controller()
+                changed = rt.get(
+                    controller.listen_for_change.remote(dict(keys)),
+                    timeout=60,
+                )
+                backoff = 0.2
+            except Exception:
+                # Controller restart/redeploy window — or it is gone
+                # for good; back off so a dead controller costs ~one
+                # lookup per 5s, and exit on serve.shutdown().
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            if not changed:
+                continue
+            with self._lock:
+                for key, update in changed.items():
+                    keys[key] = update["snapshot_id"]
+                    if key.startswith("replicas:"):
+                        self._state["replicas"] = update["value"] or []
+                        self._state["replicas_ts"] = time.time()
+                    elif update["value"] is not None:
+                        self._state["spec"] = update["value"]
 
     def _pick_replica(self) -> dict:
         self._refresh()
         deadline = time.time() + 30
         while True:
             with self._lock:
-                replicas = list(self._replicas)
+                replicas = list(self._state["replicas"])
             if replicas:
                 break
             if time.time() > deadline:
@@ -192,6 +312,16 @@ class DeploymentHandle:
                 )
             time.sleep(0.05)
             self._refresh(force=True)
+        # Locality: prefer replicas on this node when any exist
+        # (reference: pow_2 replica scheduler's locality-preferred
+        # candidate set); pow-2 needs >=2 candidates to choose among.
+        local_node = _local_node_id()
+        if local_node is not None:
+            local = [
+                r for r in replicas if r.get("node_id") == local_node
+            ]
+            if local:
+                replicas = local
         if len(replicas) == 1:
             return replicas[0]
         # Power of two choices on this router's in-flight counts.
@@ -252,34 +382,51 @@ class DeploymentHandle:
                 self._reporter = None
 
     # -- calls ---------------------------------------------------------
-    def __getattr__(self, name: str) -> "DeploymentHandle":
-        if name.startswith("_"):
-            raise AttributeError(name)
-        clone = DeploymentHandle(
-            self.app_name, self.deployment_name, name
-        )
-        # Share the routing state so ongoing counts aggregate.
+    def _share_state_with(self, clone: "DeploymentHandle") -> None:
+        # Share routing state so ongoing counts aggregate and the
+        # long-poll listener is started once per handle family.
         clone.__dict__.update(
             {
                 k: self.__dict__[k]
                 for k in (
                     "_handle_id",
                     "_lock",
-                    "_replicas",
-                    "_replicas_ts",
-                    "_spec",
+                    "_state",
                     "_ongoing",
                     "_batchers",
+                    "_listener_box",
                 )
             }
         )
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        clone = DeploymentHandle(
+            self.app_name, self.deployment_name, name
+        )
+        self._share_state_with(clone)
         clone._method = name
         return clone
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def options(self, *, stream: bool = False) -> "DeploymentHandle":
+        """`stream=True` makes remote() return a
+        DeploymentResponseGenerator whose chunks arrive as the replica
+        yields them (reference: handle.py
+        DeploymentHandle.options(stream=True))."""
+        clone = DeploymentHandle(
+            self.app_name, self.deployment_name, self._method
+        )
+        self._share_state_with(clone)
+        clone._stream = stream
+        return clone
+
+    def remote(self, *args, **kwargs):
         self._refresh()
         with self._lock:
-            batched = (self._spec or {}).get("batched_methods", {}).get(
+            batched = (
+                self._state["spec"] or {}
+            ).get("batched_methods", {}).get(
                 self._method
             )
         if batched:
@@ -294,6 +441,14 @@ class DeploymentHandle:
                 )
             return batcher.submit(args)
         replica = self._pick_replica()
+        if self._stream:
+            ref_gen = replica["actor"].handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(self._method, args, kwargs)
+            self._ongoing_sent(replica["id"])
+            return DeploymentResponseGenerator(
+                ref_gen, self, replica["id"]
+            )
         ref = replica["actor"].handle_request.remote(
             self._method, args, kwargs
         )
