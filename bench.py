@@ -265,6 +265,16 @@ def run_7b_layer_bench() -> dict:
         del state, step_fn, init_fn, tokens, inp, tgt
         gc.collect()
 
+    # A 4-layer step slower than 2-layer is required for a sane
+    # two-point fit; noise inverting them would project a negative
+    # per-layer time and a nonsensical 32-layer MFU into committed
+    # results (ADVICE r4). Refuse to project rather than emit garbage.
+    if not step_time[4] > step_time[2]:  # must survive python -O
+        raise RuntimeError(
+            f"unstable layer timing: 4-layer step "
+            f"{step_time[4]*1e3:.1f}ms <= 2-layer step "
+            f"{step_time[2]*1e3:.1f}ms — rerun on a quiet machine"
+        )
     t_layer = (step_time[4] - step_time[2]) / 2
     t_fixed = max(step_time[2] - 2 * t_layer, 0.0)
     t_32 = t_fixed + 32 * t_layer

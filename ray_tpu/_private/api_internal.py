@@ -158,14 +158,25 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         "methods": ac.method_names(),
         "class_key": class_key,
     }
-    # Actors default to 1 CPU held for their lifetime (creation
-    # resources are not released while alive); an EXPLICIT num_cpus=0
-    # yields {} — schedulable anywhere in any number (reference:
-    # ray_option_utils.py actor defaults; docs "actors require 1 CPU
-    # for scheduling", num_cpus=0 to oversubscribe).
+    # Default actors require 1 CPU to *schedule* but hold 0 for their
+    # lifetime (reference: ray_option_utils.py actor defaults —
+    # DEFAULT_ACTOR_CREATION_CPU_SIMPLE=0; the 1 CPU gates placement
+    # and is released once the actor is up, so more default actors than
+    # node CPUs still come up). Explicitly-specified resources are held
+    # for the actor's lifetime; an EXPLICIT num_cpus=0 yields {} —
+    # schedulable anywhere in any number.
+    default_resources = (
+        opts.get("num_cpus") is None
+        and not opts.get("num_tpus")
+        and not opts.get("resources")
+    )
     resources, strategy, pg_context = _resolve_placement(
         opts, _task_resources(opts, default_cpu=1.0), worker
     )
+    # A PG-targeted actor occupies its bundle slot for its lifetime
+    # even with default resources (the rewritten bundle-scoped CPU is
+    # the slot), so only non-PG default actors release after placement.
+    release_after_up = default_resources and resources == {"CPU": 1.0}
     actor_id = worker.create_actor(
         class_key,
         _flatten_args(args, kwargs),
@@ -181,6 +192,7 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         runtime_env=prepare_runtime_env(
             opts.get("runtime_env"), worker
         ),
+        release_creation_resources=release_after_up,
     )
     return ActorHandle(actor_id, meta)
 

@@ -2389,8 +2389,18 @@ class NodeDaemon:
                 self._on_actor_created_host(spec, error, conn.conn_id)
                 if error is not None:
                     self.scheduler.release(task_id)
-                # else: a live actor holds its creation resources until
-                # death (_on_actor_worker_death / actor death handling).
+                elif spec.get("release_creation_resources"):
+                    # Default-resource actor: the 1 CPU gated placement
+                    # only (reference DEFAULT_ACTOR_CREATION_CPU_SIMPLE
+                    # =0) — return it now that the actor is up so more
+                    # default actors than node CPUs still come up.
+                    # (Idempotent: the later death-path release no-ops.
+                    # _h_task_done's fall-through _schedule() dispatches
+                    # anything the freed CPU unblocks.)
+                    self.scheduler.release(task_id)
+                # else: a live actor holds its explicit creation
+                # resources until death (_on_actor_worker_death /
+                # actor death handling).
             elif spec["kind"] == "actor_task":
                 with self._lock:
                     host = self.actor_hosts.get(ActorID(spec["actor_id"]))

@@ -792,6 +792,7 @@ class CoreWorker:
         scheduling_strategy: Optional[dict] = None,
         pg_context: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
+        release_creation_resources: bool = False,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
@@ -812,6 +813,11 @@ class CoreWorker:
             "resources": (
                 resources if resources is not None else {"CPU": 1.0}
             ),
+            # True for default-resource actors: the 1 CPU is a
+            # placement-time gate only, returned once the actor is up
+            # (reference: DEFAULT_ACTOR_CREATION_CPU_SIMPLE=0 — default
+            # actors hold no lifetime CPU).
+            "release_creation_resources": release_creation_resources,
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,

@@ -78,21 +78,43 @@ def _run_child(log_path: str, env: dict) -> None:
         os._exit(0)
 
 
+def _proc_starttime(pid: int):
+    """Kernel start time (clock ticks since boot) of `pid`, or None if
+    the process is gone. Field 22 of /proc/<pid>/stat; parse after the
+    last ')' — the comm field may itself contain spaces or parens."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        return int(stat.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 class ForkedProc:
     """Popen-shaped handle for a fork-server child. The child belongs
-    to the fork-server process (which reaps it), so waitpid is
-    unavailable here; liveness is a signal-0 probe by pid."""
+    to the fork-server process (which reaps it immediately), so
+    waitpid is unavailable here AND a bare signal-0 probe is unsafe:
+    the reaped pid can be recycled by an unrelated process, making a
+    dead worker look alive (and leaking its startup-concurrency slot
+    for the whole watch window). Liveness = pid exists AND its
+    /proc starttime matches the one captured at fork."""
 
-    def __init__(self, pid: int):
+    def __init__(self, pid: int, starttime=None):
         self.pid = pid
         self._returncode = None
+        # The template reports the starttime it read while the child
+        # was still its un-reaped child (zombie at worst) — the only
+        # point where the pid provably can't have been recycled. None
+        # means the template's reaper won the race: the child is
+        # already dead, and poll() reports it so without ever
+        # trusting the (possibly recycled) pid.
+        self._starttime = starttime
 
     def poll(self):
         if self._returncode is not None:
             return self._returncode
         try:
             os.kill(self.pid, 0)
-            return None
         except ProcessLookupError:
             self._returncode = 0
             return 0
@@ -100,14 +122,25 @@ class ForkedProc:
             # pid reused by another user's process: ours is gone.
             self._returncode = 0
             return 0
+        now = _proc_starttime(self.pid)
+        if self._starttime is None or now != self._starttime:
+            # Same pid, different (or vanished) start time: the pid
+            # was recycled after our child exited.
+            self._returncode = 0
+            return 0
+        return None
 
     def terminate(self) -> None:
+        if self.poll() is not None:  # dead/recycled: never signal it
+            return
         try:
             os.kill(self.pid, 15)
         except (ProcessLookupError, PermissionError):
             pass
 
     def kill(self) -> None:
+        if self.poll() is not None:
+            return
         try:
             os.kill(self.pid, 9)
         except (ProcessLookupError, PermissionError):
@@ -217,7 +250,9 @@ class ForkServerClient:
                     self._proc.stdin.flush()
                     reply = self._read_reply(self.READY_TIMEOUT)
                     if reply and "pid" in reply:
-                        return ForkedProc(reply["pid"])
+                        return ForkedProc(
+                            reply["pid"], reply.get("starttime")
+                        )
                 except (OSError, ValueError, BrokenPipeError):
                     pass
                 # Template died mid-request: restart once and retry.
@@ -267,7 +302,21 @@ def main() -> None:
         if pid == 0:
             _run_child(req["log"], req.get("env") or {})
             # unreachable: _run_child always os._exit()s
-        os.write(out_fd, (json.dumps({"pid": pid}) + "\n").encode())
+        # Capture the child's authoritative start time HERE, where the
+        # pid cannot have been recycled yet: until the reaper thread
+        # waitpid()s it, the child (even exited) holds its /proc entry
+        # as our zombie. If the reaper won the race the read fails and
+        # the daemon treats the handle as dead-at-creation — safe, and
+        # never an impostor's starttime.
+        os.write(
+            out_fd,
+            (
+                json.dumps(
+                    {"pid": pid, "starttime": _proc_starttime(pid)}
+                )
+                + "\n"
+            ).encode(),
+        )
 
 
 if __name__ == "__main__":

@@ -20,6 +20,14 @@ from .replica import HandleRef
 from .router import DeploymentHandle
 
 PROXY_NAME = "SERVE_PROXY"
+
+
+def _proxy_name(node_id: str) -> str:
+    """Deterministic per-node proxy actor name. Keyed ONLY on the
+    node id — never on which driver called start() — so any driver on
+    any node resolves (and shuts down) every proxy (reference:
+    proxy_state.py names proxies by node id for the same reason)."""
+    return f"{PROXY_NAME}:{node_id[:12]}"
 _NAMESPACE = "serve"
 
 
@@ -145,11 +153,7 @@ def start(
     )
     local_port = None
     for node_id in node_ids:
-        name = (
-            PROXY_NAME
-            if node_id == local_node
-            else f"{PROXY_NAME}:{node_id[:12]}"
-        )
+        name = _proxy_name(node_id)
         try:
             proxy = rt.get_actor(name, namespace=_NAMESPACE)
         except ValueError:
@@ -176,14 +180,9 @@ def proxy_ports() -> Dict[str, int]:
     """node_id -> bound proxy port for every running proxy."""
     rt = _rt()
     out: Dict[str, int] = {}
-    local_node = rt.get_runtime_context().get_node_id()
     for node in rt.nodes():
         node_id = node["node_id"]
-        name = (
-            PROXY_NAME
-            if node_id == local_node
-            else f"{PROXY_NAME}:{node_id[:12]}"
-        )
+        name = _proxy_name(node_id)
         try:
             proxy = rt.get_actor(name, namespace=_NAMESPACE)
             out[node_id] = rt.get(proxy.ready.remote(), timeout=30)
@@ -233,15 +232,11 @@ def shutdown() -> None:
         rt.get(controller.shutdown_all.remote(), timeout=60)
     except Exception:
         pass
-    # Kill every per-node proxy (local name + node-suffixed names).
-    names = [PROXY_NAME]
+    # Kill every per-node proxy (names are node-id-keyed, so any
+    # driver — not just the one that called start() — finds them all).
+    names = []
     try:
-        local_node = rt.get_runtime_context().get_node_id()
-        names += [
-            f"{PROXY_NAME}:{n['node_id'][:12]}"
-            for n in rt.nodes()
-            if n["node_id"] != local_node
-        ]
+        names = [_proxy_name(n["node_id"]) for n in rt.nodes()]
     except Exception:
         pass
     for name in names:

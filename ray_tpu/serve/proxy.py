@@ -17,6 +17,7 @@ wire while the replica computes token N+1.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -229,8 +230,12 @@ class Proxy:
         # Once the 200 header is out, NOTHING may escape this method:
         # a propagated exception would make the outer handler write a
         # second (500) response onto the same keep-alive connection,
-        # desynchronizing the next request. Mid-stream errors end the
-        # chunk stream early — the HTTP-correct failure surface.
+        # desynchronizing the next request. The 0-length terminator is
+        # written ONLY on clean completion — a replica error mid-stream
+        # aborts the socket so the client observes a truncated chunked
+        # body (a detectable failure) instead of a well-formed 200 with
+        # silently missing content.
+        clean = False
         try:
             try:
                 for chunk in chunks:
@@ -245,15 +250,23 @@ class Proxy:
                         f"{len(data):X}\r\n".encode() + data + b"\r\n"
                     )
                     handler.wfile.flush()
+                clean = True
             finally:
                 # Releases the router's ongoing-count slot even when
                 # the client disconnected mid-stream.
                 close = getattr(chunks, "close", None)
                 if close is not None:
                     close()
-                handler.wfile.write(b"0\r\n\r\n")
+                if clean:
+                    handler.wfile.write(b"0\r\n\r\n")
+                else:
+                    handler.close_connection = True
+                    try:
+                        handler.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
         except Exception:
-            pass
+            handler.close_connection = True
 
     def ready(self) -> int:
         return self.port

@@ -317,6 +317,40 @@ def test_streaming_handle_and_http(serve_session):
     assert first_at < 0.60, f"first HTTP chunk too late: {first_at}"
 
 
+def test_streaming_error_truncates_chunked_body(serve_session):
+    """A replica generator that raises mid-stream must NOT produce a
+    well-formed chunked body: the proxy aborts the socket without the
+    terminal 0-chunk so the client sees a protocol-level truncation
+    (http.client raises IncompleteRead/connection error) rather than a
+    clean 200 with silently missing content (reference: ASGI proxies
+    surface mid-stream failure by killing the connection — the
+    response is unrecoverable once the 200 status line is out)."""
+    import http.client
+
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Flaky:
+        def __call__(self, request):
+            yield "good "
+            raise RuntimeError("replica exploded mid-stream")
+
+    serve.run(Flaky.bind(), name="flaky", route_prefix="/flaky")
+    port = serve.start(per_node=False)
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/flaky")
+        resp = conn.getresponse()
+        assert resp.status == 200  # headers were already committed
+        with pytest.raises(
+            (http.client.IncompleteRead, ConnectionError, OSError)
+        ):
+            resp.read()
+    finally:
+        conn.close()
+
+
 def test_per_node_proxies_route_local_first():
     """serve.start places a proxy on EVERY node (reference:
     proxy_state.py), and each proxy's router prefers replicas on its

@@ -112,6 +112,81 @@ def test_zero_cpu_actors_pack_past_worker_cap():
         rt.shutdown()
 
 
+def test_forked_proc_detects_recycled_pid():
+    """ForkedProc.poll() must not trust a bare signal-0 probe: the
+    fork-server reaps children immediately, so an exited worker's pid
+    can be recycled by an unrelated process. Liveness requires the
+    /proc starttime captured at fork to still match; a mismatch (here
+    simulated by tampering the captured value against a live pid)
+    reads as dead, and terminate()/kill() then refuse to signal the
+    innocent holder of the recycled pid."""
+    import os
+
+    from ray_tpu._private.worker_forkserver import (
+        ForkedProc,
+        _proc_starttime,
+    )
+
+    me = os.getpid()
+    mine = _proc_starttime(me)
+    assert mine is not None
+    live = ForkedProc(me, mine)
+    assert live.poll() is None  # genuinely alive, starttime matches
+
+    recycled = ForkedProc(me, mine - 1)  # pretend an older child
+    assert recycled.poll() == 0
+    recycled.kill()  # must be a no-op, not SIGKILL to ourselves
+    assert os.getpid() == me
+
+    # Template's reaper won the race: starttime arrives as None and
+    # the handle reads dead without trusting the pid at all.
+    assert ForkedProc(me, None).poll() == 0
+
+    gone = ForkedProc(2**22 - 17, 123)  # vanishingly unlikely to exist
+    assert gone.poll() == 0
+
+
+def test_default_actors_exceed_node_cpus():
+    """Default actors need 1 CPU to *schedule* but hold 0 for their
+    lifetime (reference: DEFAULT_ACTOR_CREATION_CPU_SIMPLE=0 — the
+    1 CPU is placement-only and released after scheduling), so more
+    default actors than node CPUs still all come up. Regression:
+    holding the creation CPU for the lifetime queued the third actor
+    forever on a 2-CPU node with no error."""
+    rt.init(num_cpus=2)
+    try:
+        @rt.remote
+        class A:
+            def ping(self):
+                return "up"
+
+        actors = [A.remote() for _ in range(5)]
+        assert rt.get(
+            [a.ping.remote() for a in actors], timeout=90
+        ) == ["up"] * 5
+
+        # The released CPUs are genuinely back: plain 1-CPU tasks
+        # still run while all five actors are alive.
+        @rt.remote
+        def f():
+            return 7
+
+        assert rt.get([f.remote() for _ in range(4)], timeout=60) == [7] * 4
+
+        # EXPLICIT num_cpus keeps lifetime-hold semantics: a sixth
+        # actor demanding 2 full CPUs schedules too (the default
+        # actors freed theirs), and holds them.
+        @rt.remote(num_cpus=2)
+        class Holder:
+            def ping(self):
+                return "held"
+
+        h = Holder.remote()
+        assert rt.get(h.ping.remote(), timeout=60) == "held"
+    finally:
+        rt.shutdown()
+
+
 def test_fork_server_spawns_workers():
     """Workers come from the warm fork-server template by default;
     they must execute tasks and report distinct pids (the template's
