@@ -3760,6 +3760,13 @@ class NodeDaemon:
                     "total": total,
                     "available": available,
                     "queued": queued,
+                    # Provider-node mapping for the autoscaler: a
+                    # multi-host TPU slice is ONE provider node whose
+                    # N host daemons each carry the provider-node
+                    # label (reference: GCP provider matches instances
+                    # to raylets by ip; labels are the tpu-native
+                    # equivalent that survives NAT/fake clusters).
+                    "labels": dict(info.labels or {}),
                 }
             )
         return {

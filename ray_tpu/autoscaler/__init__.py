@@ -1,7 +1,7 @@
 """Autoscaling (reference: python/ray/autoscaler)."""
 
 from .autoscaler import Monitor, NodeTypeConfig, StandardAutoscaler
-from .cluster import AutoscalingCluster
+from .cluster import AutoscalingCluster, TpuAutoscalingCluster
 from .node_provider import FakeMultiNodeProvider, NodeProvider
 
 __all__ = [
@@ -11,4 +11,5 @@ __all__ = [
     "NodeProvider",
     "FakeMultiNodeProvider",
     "AutoscalingCluster",
+    "TpuAutoscalingCluster",
 ]

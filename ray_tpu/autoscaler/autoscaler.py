@@ -11,10 +11,18 @@ Loop: read demand (infeasible tasks + pending placement-group
 bundles) -> bin-pack what doesn't fit on live/launching nodes into the
 cheapest satisfying node types (bounded by max_workers) -> launch;
 terminate workers idle past idle_timeout (respecting min_workers).
+
+Slice granularity: a node type with `slice_hosts > 1` is a TPU pod
+slice — ONE provider node that boots N host daemons (reference:
+gcp/node.py GCPTPUNode spans numNetworkEndpoints hosts). Pending
+STRICT_SPREAD gangs (slice_placement_group) are packed onto distinct
+hosts, and an unmet gang launches one slice — never N separate nodes —
+so slice scale-up is atomic.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -22,13 +30,20 @@ from typing import Dict, List, Optional
 
 from .node_provider import NodeProvider
 
+#: Cluster-side label a daemon carries to name its cloud node; N slice
+#: host daemons share one value (gcp/node_provider.py writes it into
+#: the startup script).
+PROVIDER_NODE_LABEL = "rt.io/provider-node"
+
 
 @dataclass
 class NodeTypeConfig:
-    resources: Dict[str, float]
+    resources: Dict[str, float]  # PER-HOST resources
     min_workers: int = 0
     max_workers: int = 10
     labels: Dict[str, str] = field(default_factory=dict)
+    #: Hosts that join per provider node (1 = plain VM; >1 = pod slice).
+    slice_hosts: int = 1
 
 
 def _fits(request: Dict[str, float], capacity: Dict[str, float]) -> bool:
@@ -68,64 +83,148 @@ class StandardAutoscaler:
             self._client = RpcClient(self.provider.head_address)
         return self._client.call("cluster_load")
 
+    def _daemons_of(self, provider_id: str, load: dict) -> List[dict]:
+        """Cluster nodes belonging to one provider node: by the
+        provider-node label (slice nodes, N daemons), falling back to
+        the provider's own single-node mapping."""
+        daemons = [
+            n
+            for n in load["nodes"]
+            if (n.get("labels") or {}).get(PROVIDER_NODE_LABEL)
+            == provider_id
+        ]
+        if daemons:
+            return daemons
+        cid = self.provider.cluster_node_id(provider_id)
+        return [n for n in load["nodes"] if n["node_id"] == cid]
+
     # -- one reconcile pass (reference: StandardAutoscaler.update) ----
     def update(self) -> dict:
         load = self._load()
-        demand: List[Dict[str, float]] = list(load["infeasible"])
-        for pg in load["pending_placement_groups"]:
-            demand.extend(pg["bundles"])
 
-        # Capacity view: live worker availability + launching nodes.
-        live_available = [
-            dict(node["available"])
-            for node in load["nodes"]
+        # Demand. Gangs (STRICT_SPREAD / SPREAD placement groups) need
+        # DISTINCT hosts per bundle; everything else packs freely.
+        flat: List[Dict[str, float]] = [
+            r for r in load["infeasible"] if r
         ]
-        launching: List[Dict[str, float]] = []
-        provider_nodes = self.provider.non_terminated_nodes()
-        live_ids = {n["node_id"] for n in load["nodes"]}
-        for p in provider_nodes:
-            if self.provider.cluster_node_id(p) not in live_ids:
-                node_type = self.provider.node_type(p)
-                if node_type in self.node_types:
-                    launching.append(
-                        dict(self.node_types[node_type].resources)
-                    )
+        gangs: List[List[Dict[str, float]]] = []
+        for pg in load["pending_placement_groups"]:
+            bundles = [dict(b) for b in pg["bundles"] if b]
+            if not bundles:
+                continue
+            if pg.get("strategy") in ("STRICT_SPREAD", "SPREAD"):
+                gangs.append(bundles)
+            else:
+                flat.extend(bundles)
 
-        # min_workers floor.
-        to_launch: Dict[str, int] = {}
+        # Capacity pool: one entry per live daemon + one per HOST of
+        # every launching provider node (a booting v5e-16 slice is 4
+        # distinct prospective hosts, not one blob).
+        pool: List[Dict[str, float]] = [
+            dict(node["available"]) for node in load["nodes"]
+        ]
+        provider_nodes = self.provider.non_terminated_nodes()
         counts: Dict[str, int] = {}
         for p in provider_nodes:
             node_type = self.provider.node_type(p)
             counts[node_type] = counts.get(node_type, 0) + 1
+            if not self._daemons_of(p, load):  # still launching
+                cfg = self.node_types.get(node_type)
+                if cfg is not None:
+                    pool.extend(
+                        dict(cfg.resources)
+                        for _ in range(max(1, cfg.slice_hosts))
+                    )
+
+        # min_workers floor.
+        to_launch: Dict[str, int] = {}
         for name, cfg in self.node_types.items():
             if counts.get(name, 0) < cfg.min_workers:
                 to_launch[name] = cfg.min_workers - counts.get(name, 0)
 
-        # Bin-pack unmet demand (reference: resource_demand_scheduler).
-        pool = live_available + launching
-        for request in demand:
-            if not request:
-                continue
+        def _type_room(name: str) -> int:
+            cfg = self.node_types[name]
+            return cfg.max_workers - (
+                counts.get(name, 0) + to_launch.get(name, 0)
+            )
+
+        def _launch_for(request: Dict[str, float], distinct_needed=1):
+            """Pick the first node type that fits `request` per host
+            and can supply `distinct_needed` hosts in as few provider
+            nodes as possible. Returns pool entries added (one per new
+            host) or None."""
+            for name, cfg in sorted(
+                self.node_types.items(),
+                # Prefer types whose slice covers the whole gang in
+                # one node (slice-granular scale-up), then fewer
+                # wasted hosts.
+                key=lambda kv: (
+                    kv[1].slice_hosts < distinct_needed,
+                    kv[1].slice_hosts,
+                    kv[0],
+                ),
+            ):
+                if _type_room(name) <= 0:
+                    continue
+                if not _fits(request, cfg.resources):
+                    continue
+                nodes_needed = max(
+                    1, math.ceil(distinct_needed / cfg.slice_hosts)
+                )
+                if _type_room(name) < nodes_needed:
+                    continue
+                to_launch[name] = to_launch.get(name, 0) + nodes_needed
+                fresh = [
+                    dict(cfg.resources)
+                    for _ in range(nodes_needed * cfg.slice_hosts)
+                ]
+                pool.extend(fresh)
+                return fresh
+            return None
+
+        # Bin-pack flat demand (reference: resource_demand_scheduler).
+        for request in flat:
             placed = False
             for capacity in pool:
                 if _fits(request, capacity):
                     _consume(capacity, request)
                     placed = True
                     break
-            if placed:
-                continue
-            for name, cfg in sorted(self.node_types.items()):
-                total = counts.get(name, 0) + to_launch.get(name, 0)
-                if total >= cfg.max_workers:
-                    continue
-                if _fits(request, cfg.resources):
-                    to_launch[name] = to_launch.get(name, 0) + 1
-                    fresh = dict(cfg.resources)
-                    _consume(fresh, request)
-                    pool.append(fresh)
-                    placed = True
-                    break
+            if not placed:
+                added = _launch_for(request)
+                if added:
+                    _consume(added[0], request)
             # Unplaceable anywhere: reported, not fatal.
+
+        # Pack gangs: each bundle on a DISTINCT pool entry; an unmet
+        # remainder launches whole slices (one provider node covers up
+        # to slice_hosts bundles — the slice_placement_group ->
+        # tpu-v5e-16 path).
+        for bundles in gangs:
+            used: set = set()
+            unplaced: List[Dict[str, float]] = []
+            for request in bundles:
+                placed = False
+                for idx, capacity in enumerate(pool):
+                    if idx in used:
+                        continue
+                    if _fits(request, capacity):
+                        _consume(capacity, request)
+                        used.add(idx)
+                        placed = True
+                        break
+                if not placed:
+                    unplaced.append(request)
+            if unplaced:
+                # Homogeneous-gang launch sized by the largest bundle
+                # (slice bundles are uniform per-host chip sets).
+                biggest = max(
+                    unplaced, key=lambda b: sorted(b.items())
+                )
+                added = _launch_for(biggest, len(unplaced))
+                if added:
+                    for request, capacity in zip(unplaced, added):
+                        _consume(capacity, request)
 
         launched = []
         for name, count in to_launch.items():
@@ -137,18 +236,22 @@ class StandardAutoscaler:
                     )
                 )
 
-        # Scale down idle workers (reference: idle node termination).
+        # Scale down idle provider nodes. A slice node is idle only
+        # when EVERY host daemon is idle (reference: idle node
+        # termination; v2 kills whole TPU pods, never partial slices).
         terminated = []
         now = time.time()
-        cluster_by_id = {n["node_id"]: n for n in load["nodes"]}
         for p in list(provider_nodes):
-            cluster_id = self.provider.cluster_node_id(p)
-            node = cluster_by_id.get(cluster_id)
-            if node is None:
+            daemons = self._daemons_of(p, load)
+            if not daemons:
                 continue  # still launching
-            busy = node["queued"] > 0 or any(
-                node["available"].get(k, 0.0) != v
-                for k, v in node["total"].items()
+            busy = any(
+                node["queued"] > 0
+                or any(
+                    node["available"].get(k, 0.0) != v
+                    for k, v in node["total"].items()
+                )
+                for node in daemons
             )
             if busy:
                 self._last_busy[p] = now
@@ -166,7 +269,7 @@ class StandardAutoscaler:
                 counts[node_type] = type_count - 1
                 terminated.append(p)
         return {
-            "demand": len(demand),
+            "demand": len(flat) + sum(len(g) for g in gangs),
             "launched": launched,
             "terminated": terminated,
         }

@@ -57,3 +57,94 @@ class AutoscalingCluster:
         self.monitor.stop()
         self.provider.shutdown()
         self.cluster.shutdown()
+
+
+class TpuAutoscalingCluster:
+    """A head plus a GcpTpuNodeProvider driven against the in-memory
+    fake TPU API — the hermetic test double for slice-granular
+    autoscaling (reference: the GCP provider's unit tests stub the
+    googleapiclient HTTP layer the same way). Production swaps the
+    fake transport for the default RestTransport; everything above the
+    transport (client, provider, autoscaler) is the code under test.
+
+    `tpu_node_types` example::
+
+        {"tpu-v5e-16": {"pod_type": "v5e-16",
+                        "accelerator_type": "v5litepod-16",
+                        "max_workers": 2, "host_cpus": 2.0}}
+    """
+
+    def __init__(
+        self,
+        head_resources: Optional[Dict[str, float]] = None,
+        tpu_node_types: Optional[Dict[str, dict]] = None,
+        idle_timeout_s: float = 3.0,
+        update_interval_s: float = 0.3,
+    ):
+        from .._private.accelerators.tpu import (
+            chips_per_host,
+            pod_worker_count,
+        )
+        from .gcp import (
+            FakeGcpTpuService,
+            GcpTpuNodeProvider,
+        )
+        from .gcp.node_provider import FakeSliceHostBooter
+
+        self.cluster = Cluster(
+            initialize_head=True,
+            head_resources=head_resources or {"CPU": 1.0},
+        )
+        tpu_node_types = tpu_node_types or {}
+        self.booter = FakeSliceHostBooter(
+            self.cluster.address,
+            self.cluster.session_dir,
+            tpu_node_types=tpu_node_types,
+        )
+        self.service = FakeGcpTpuService(
+            project="fake-project",
+            zone="fake-zone-a",
+            on_node_ready=self.booter.node_ready,
+            on_node_deleted=self.booter.node_deleted,
+        )
+        self.provider = GcpTpuNodeProvider(
+            self.cluster.address,
+            project="fake-project",
+            zone="fake-zone-a",
+            cluster_name="rt-test",
+            tpu_node_types=tpu_node_types,
+            transport=self.service,
+        )
+        types = {}
+        for name, spec in tpu_node_types.items():
+            pod_type = spec["pod_type"]
+            types[name] = NodeTypeConfig(
+                resources={
+                    "CPU": float(spec.get("host_cpus", 2.0)),
+                    "TPU": float(chips_per_host(pod_type)),
+                    "memory": float(2**30),
+                },
+                min_workers=spec.get("min_workers", 0),
+                max_workers=spec.get("max_workers", 2),
+                slice_hosts=pod_worker_count(pod_type),
+            )
+        self.autoscaler = StandardAutoscaler(
+            self.provider, types, idle_timeout_s=idle_timeout_s
+        )
+        self.monitor = Monitor(self.autoscaler, update_interval_s)
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def start(self) -> None:
+        self.monitor.start()
+
+    def num_slices(self) -> int:
+        return len(self.provider.non_terminated_nodes())
+
+    def shutdown(self) -> None:
+        self.monitor.stop()
+        self.provider.shutdown()
+        self.booter.shutdown()
+        self.cluster.shutdown()

@@ -1,0 +1,20 @@
+"""GCE/GKE TPU node provider.
+
+Reference: python/ray/autoscaler/_private/gcp/ — node_provider.py
+(GCPNodeProvider), node.py:629 (GCPTPU REST resource, GCPNodeType.TPU),
+tpu_command_runner.py (per-host fan-out). The tpu-native redesign keeps
+the same cloud surface (TPU v2 REST API: nodes.create/list/get/delete +
+operations.get) but treats a pod SLICE as the atomic scaling unit: one
+provider node = one slice = N host daemons that join the cluster with
+pod-head resources, so a pending `slice_placement_group` maps to
+exactly one node request.
+"""
+
+from .api import FakeGcpTpuService, GcpTpuClient
+from .node_provider import GcpTpuNodeProvider
+
+__all__ = [
+    "FakeGcpTpuService",
+    "GcpTpuClient",
+    "GcpTpuNodeProvider",
+]

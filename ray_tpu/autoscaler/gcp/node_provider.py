@@ -1,0 +1,262 @@
+"""GCE TPU node provider: one provider node == one pod slice.
+
+Reference: python/ray/autoscaler/_private/gcp/node_provider.py
+(GCPNodeProvider) + node.py:108 (GCPNodeType.TPU routes node names to
+the TPU API) + tpu_command_runner.py (the reference reaches every slice
+host over SSH). TPU-native redesign: instead of a command runner
+fanning out to hosts, each TPU VM host boots its own daemon from the
+node's startup script (cloud-init), tagged with the provider-node
+label; the autoscaler then maps N joined daemons back to this one
+provider node. Scale-up granularity is the SLICE — the autoscaler
+launches one node per pending `slice_placement_group`, never partial
+slices.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+from ..._private.accelerators.tpu import chips_per_host, pod_worker_count
+from ..node_provider import NodeProvider
+from .api import FakeGcpTpuService, GcpApiError, GcpTpuClient
+
+#: Label keys on the cloud node (GCP label values must be lowercase;
+#: these mirror the reference's ray-cluster-name / ray-node-type tags).
+LABEL_CLUSTER = "rt-cluster-name"
+LABEL_NODE_TYPE = "rt-node-type"
+
+#: Label key the joined daemons carry (cluster side, free-form).
+PROVIDER_NODE_LABEL = "rt.io/provider-node"
+
+
+def _startup_script(head_address: str, provider_node: str) -> str:
+    """The per-host boot script baked into node metadata. Every TPU VM
+    host of the slice runs it (reference: the GCP provider's
+    startup-script metadata; TPU_WORKER_ID etc. are provided by the
+    TPU VM environment and picked up by accelerator detection)."""
+    labels = json.dumps({PROVIDER_NODE_LABEL: provider_node})
+    return (
+        "#!/bin/bash\n"
+        f"python -m ray_tpu start --address={head_address} "
+        f"--labels='{labels}' "
+        "--listen-host=$(hostname -I | awk '{print $1}')\n"
+    )
+
+
+class GcpTpuNodeProvider(NodeProvider):
+    """Drives the TPU v2 REST surface through GcpTpuClient.
+
+    `tpu_node_types` maps autoscaler node-type names to their cloud
+    shape::
+
+        {"tpu-v5e-16": {"pod_type": "v5e-16",
+                        "accelerator_type": "v5litepod-16",
+                        "runtime_version": "tpu-ubuntu2204-base"}}
+
+    Creation is asynchronous (the cloud operation completes in the
+    background; CREATING nodes count as launching capacity). The
+    provider never blocks the reconcile loop on cloud latency.
+    """
+
+    def __init__(
+        self,
+        head_address: str,
+        *,
+        project: str,
+        zone: str,
+        cluster_name: str,
+        tpu_node_types: Dict[str, dict],
+        transport=None,
+    ):
+        super().__init__(head_address)
+        self.cluster_name = cluster_name
+        self.tpu_node_types = tpu_node_types
+        self.client = GcpTpuClient(
+            project, zone, transport=transport, poll_interval_s=0.05
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- capacity shape ------------------------------------------------
+    def slice_hosts(self, node_type: str) -> int:
+        spec = self.tpu_node_types.get(node_type)
+        if not spec:
+            return 1
+        return pod_worker_count(spec["pod_type"])
+
+    def host_chips(self, node_type: str) -> int:
+        spec = self.tpu_node_types.get(node_type)
+        if not spec:
+            return 0
+        return chips_per_host(spec["pod_type"])
+
+    # -- NodeProvider surface ------------------------------------------
+    def create_node(self, node_type, resources, labels) -> str:
+        spec = self.tpu_node_types[node_type]
+        with self._lock:
+            self._seq += 1
+            short = f"{self.cluster_name}-{node_type}-{self._seq}-tpu"
+        body = {
+            "acceleratorType": spec["accelerator_type"],
+            "runtimeVersion": spec.get(
+                "runtime_version", "tpu-ubuntu2204-base"
+            ),
+            "networkConfig": {"enableExternalIps": True},
+            "labels": {
+                LABEL_CLUSTER: self.cluster_name,
+                LABEL_NODE_TYPE: node_type,
+                **{
+                    str(k).lower(): str(v).lower()
+                    for k, v in (labels or {}).items()
+                },
+            },
+            "metadata": {
+                "startup-script": _startup_script(
+                    self.head_address, short
+                ),
+                "rt-slice-hosts": str(self.slice_hosts(node_type)),
+            },
+        }
+        # Fire-and-track: nodes.create returns a long-running
+        # operation; the node lists as CREATING until the service
+        # finishes (reference: create_instance(wait_for_operation=
+        # False) path).
+        self.client.create_node(short, body)
+        return short
+
+    def _full_name(self, short: str) -> str:
+        return f"{self.client.parent}/nodes/{short}"
+
+    def terminate_node(self, node_id: str) -> None:
+        try:
+            self.client.delete_node(self._full_name(node_id))
+        except GcpApiError as e:
+            if e.status != 404:
+                raise
+
+    def _cluster_nodes(self) -> List[dict]:
+        return [
+            n
+            for n in self.client.list_nodes()
+            if n.get("labels", {}).get(LABEL_CLUSTER) == self.cluster_name
+            and n.get("state") not in ("DELETING", "TERMINATED")
+        ]
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [n["name"].rsplit("/", 1)[1] for n in self._cluster_nodes()]
+
+    def node_type(self, node_id: str) -> Optional[str]:
+        try:
+            node = self.client.get_node(self._full_name(node_id))
+        except GcpApiError:
+            return None
+        return node.get("labels", {}).get(LABEL_NODE_TYPE)
+
+    def cluster_node_id(self, node_id: str) -> Optional[str]:
+        """Unused for slice nodes: N daemons map to one provider node
+        via the rt.io/provider-node label the autoscaler reads from
+        cluster_load (see StandardAutoscaler._nodes_by_provider)."""
+        return None
+
+    def provider_node_label(self, node_id: str) -> str:
+        return node_id
+
+    def shutdown(self) -> None:
+        for node_id in self.non_terminated_nodes():
+            try:
+                self.terminate_node(node_id)
+            except GcpApiError:
+                pass
+
+
+class FakeSliceHostBooter:
+    """Plays the role of cloud-init on a fake TPU slice: when the fake
+    service marks a node READY, boot one in-process NodeDaemon per
+    slice host with exactly the resources/labels the accelerator
+    manager would detect on a real TPU VM host (reference test model:
+    fake_multi_node/node_provider.py boots real raylets; here the
+    hosts additionally carry pod-head + pod-name slice resources,
+    accelerators/tpu.py get_extra_resources_and_labels)."""
+
+    def __init__(
+        self,
+        head_address: str,
+        session_root: str,
+        *,
+        host_cpus: float = 2.0,
+        tpu_node_types: Optional[Dict[str, dict]] = None,
+    ):
+        self.head_address = head_address
+        self.session_root = session_root
+        self.host_cpus = host_cpus
+        self.tpu_node_types = tpu_node_types or {}
+        self._lock = threading.Lock()
+        self._daemons: Dict[str, list] = {}
+
+    def node_ready(self, name: str, node: dict) -> None:
+        import os
+
+        from ..._private.config import Config
+        from ..._private.daemon import NodeDaemon
+
+        short = name.rsplit("/", 1)[1]
+        node_type = node.get("labels", {}).get(LABEL_NODE_TYPE, "")
+        spec = self.tpu_node_types.get(node_type, {})
+        pod_type = spec.get("pod_type", "v5e-4")
+        hosts = pod_worker_count(pod_type)
+        per_host = chips_per_host(pod_type)
+        booted = []
+        for worker_id in range(hosts):
+            resources = {
+                "CPU": self.host_cpus,
+                "TPU": float(per_host),
+                "memory": float(2**30),
+                # Every host advertises the pod-name resource; host 0
+                # adds the slice-head marker (accelerators/tpu.py
+                # get_extra_resources_and_labels, reference tpu.py:334).
+                short: 1.0,
+            }
+            if worker_id == 0:
+                resources[f"TPU-{pod_type}-head"] = 1.0
+            labels = {
+                PROVIDER_NODE_LABEL: short,
+                "rt.io/tpu-pod-type": pod_type,
+                "rt.io/tpu-pod-name": short,
+                "rt.io/tpu-worker-id": str(worker_id),
+            }
+            daemon = NodeDaemon(
+                os.path.join(self.session_root, f"{short}-w{worker_id}"),
+                resources,
+                Config.from_env(None),
+                is_head=False,
+                head_address=self.head_address,
+                labels=labels,
+            )
+            daemon.start()
+            booted.append(daemon)
+        with self._lock:
+            self._daemons[short] = booted
+
+    def node_deleted(self, name: str) -> None:
+        short = name.rsplit("/", 1)[1]
+        with self._lock:
+            booted = self._daemons.pop(short, [])
+        for daemon in booted:
+            try:
+                daemon.shutdown()
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            all_daemons = [
+                d for ds in self._daemons.values() for d in ds
+            ]
+            self._daemons.clear()
+        for daemon in all_daemons:
+            try:
+                daemon.shutdown()
+            except Exception:
+                pass

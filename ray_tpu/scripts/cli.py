@@ -77,6 +77,8 @@ def cmd_start(args) -> None:
     detected, labels = detect_accelerators(
         {"TPU": float(args.num_tpus)} if args.num_tpus is not None else None
     )
+    if getattr(args, "labels", None):
+        labels.update(json.loads(args.labels))
     for name, amount in detected.items():
         resources.setdefault(name, amount)
     resources.setdefault("memory", float(2**34))
@@ -282,6 +284,12 @@ def main(argv=None) -> None:
     p_start.add_argument("--num-tpus", type=float, default=None)
     p_start.add_argument(
         "--resources", help='extra resources as JSON, e.g. \'{"A": 2}\''
+    )
+    p_start.add_argument(
+        "--labels",
+        help="node labels as JSON (cloud startup scripts tag nodes "
+        'with their provider identity, e.g. \'{"rt.io/provider-node": '
+        '"my-tpu-0"}\')',
     )
     p_start.add_argument("--session-dir")
     p_start.add_argument(

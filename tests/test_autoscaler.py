@@ -83,6 +83,142 @@ def test_respects_max_workers(scaling_cluster):
     rt.get(refs, timeout=120)
 
 
+def test_gcp_tpu_client_against_fake_service():
+    """GcpTpuClient speaks the TPU v2 REST surface (reference:
+    gcp/node.py:629 GCPTPU): create returns a long-running operation,
+    polling completes it, the node lists READY with one
+    networkEndpoint per slice host, delete removes it."""
+    from ray_tpu.autoscaler.gcp import FakeGcpTpuService, GcpTpuClient
+    from ray_tpu.autoscaler.gcp.api import GcpApiError
+
+    service = FakeGcpTpuService(ready_delay_s=0.01)
+    client = GcpTpuClient(
+        "proj", "fake-zone-a", transport=service, poll_interval_s=0.01
+    )
+    op = client.create_node(
+        "my-slice-tpu",
+        {
+            "acceleratorType": "v5litepod-16",
+            "runtimeVersion": "tpu-ubuntu2204-base",
+            "labels": {"rt-cluster-name": "c"},
+            "metadata": {"rt-slice-hosts": "4"},
+        },
+    )
+    assert not op.get("done")
+    done = client.wait_for_operation(op, timeout_s=10)
+    assert done["done"] and "error" not in done
+
+    nodes = client.list_nodes()
+    assert len(nodes) == 1
+    node = nodes[0]
+    assert node["state"] == "READY"
+    assert len(node["networkEndpoints"]) == 4  # one per slice host
+    assert client.get_node(node["name"])["state"] == "READY"
+
+    client.delete_node(node["name"])
+    assert client.list_nodes() == []
+    with pytest.raises(GcpApiError):
+        client.get_node(node["name"])
+
+
+def test_slice_pg_scales_up_one_tpu_node_then_down():
+    """The slice-granular TPU scale-up path end-to-end (reference:
+    gcp/node_provider.py + node.py GCPNodeType.TPU): one pending
+    slice_placement_group drives ONE tpu-v5e-16 node request through
+    the fake TPU API; its 4 host daemons join with pod-head + pod-name
+    resources; the gang schedules; after release the whole slice (and
+    only the slice, never a partial host set) scales down on idle."""
+    import ray_tpu as rt
+    from ray_tpu.autoscaler import TpuAutoscalingCluster
+    from ray_tpu.util.accelerators.tpu import slice_placement_group
+    from ray_tpu.util.placement_group import remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    cluster = TpuAutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        tpu_node_types={
+            "tpu-v5e-16": {
+                "pod_type": "v5e-16",
+                "accelerator_type": "v5litepod-16",
+                "max_workers": 2,
+                "host_cpus": 2.0,
+            },
+        },
+        idle_timeout_s=2.0,
+    )
+    cluster.start()
+    try:
+        rt.init(address=cluster.address)
+        assert cluster.num_slices() == 0
+
+        pg = slice_placement_group("v5e-16")
+        assert pg.wait(90), "slice gang never scheduled"
+
+        # Slice granularity: the 4-bundle STRICT_SPREAD gang launched
+        # exactly ONE provider node (not 4), with 4 host daemons.
+        assert cluster.num_slices() == 1
+        # Filter by label, not by the TPU resource: a committed bundle
+        # rewrites the host's TPU into PG-group-scoped keys.
+        tpu_hosts = [
+            n
+            for n in rt.nodes()
+            if n.get("alive")
+            and n["labels"].get("rt.io/tpu-pod-type") == "v5e-16"
+        ]
+        assert len(tpu_hosts) == 4
+        # Host 0 carries the slice-head marker; every host carries the
+        # pod-name resource (accelerators/tpu.py, reference tpu.py:334).
+        heads = [
+            n
+            for n in tpu_hosts
+            if "TPU-v5e-16-head" in n["resources"]
+        ]
+        assert len(heads) == 1
+        provider_nodes = {
+            n["labels"].get("rt.io/provider-node") for n in tpu_hosts
+        }
+        assert len(provider_nodes) == 1
+        pod_name = provider_nodes.pop()
+        assert all(
+            n["resources"].get(pod_name) == 1.0 for n in tpu_hosts
+        )
+
+        # The gang is actually usable: one task per bundle, spread
+        # across distinct hosts.
+        # num_cpus=0: the bundle holds only the host's chip set, so
+        # the gang task must not ask the bundle for CPU too.
+        @rt.remote(num_tpus=4, num_cpus=0)
+        def host_id():
+            return rt.get_runtime_context().get_node_id()
+
+        refs = [
+            host_id.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i
+                )
+            ).remote()
+            for i in range(4)
+        ]
+        assert len(set(rt.get(refs, timeout=60))) == 4
+
+        # Release the gang: the slice idles out and terminates as one
+        # unit through the fake TPU API delete.
+        remove_placement_group(pg)
+        deadline = time.time() + 45
+        while time.time() < deadline and cluster.num_slices() > 0:
+            time.sleep(0.3)
+        assert cluster.num_slices() == 0
+        rt.shutdown()
+    finally:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
 def test_min_workers_floor():
     import ray_tpu as rt
     from ray_tpu.autoscaler import AutoscalingCluster
