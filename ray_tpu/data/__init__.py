@@ -1,7 +1,12 @@
 """Distributed datasets (reference: python/ray/data)."""
 
 from .block import Batch, Block
-from .dataset import DataIterator, Dataset, GroupedData
+from .dataset import (
+    ActorPoolStrategy,
+    DataIterator,
+    Dataset,
+    GroupedData,
+)
 from .read_api import (
     from_items,
     from_numpy,
@@ -14,6 +19,7 @@ from .read_api import (
 )
 
 __all__ = [
+    "ActorPoolStrategy",
     "Dataset",
     "DataIterator",
     "GroupedData",

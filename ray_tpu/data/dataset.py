@@ -27,6 +27,7 @@ from .block import (
     rows_to_batch,
 )
 from .executor import (
+    ActorPoolStage,
     AllToAllStage,
     LimitStage,
     MapStage,
@@ -36,15 +37,65 @@ from .executor import (
 )
 
 
+class ActorPoolStrategy:
+    """Compute strategy running a stage's UDF on warm, reusable actors
+    (reference: python/ray/data/_internal/compute.py ActorPoolStrategy
+    + operators/actor_pool_map_operator.py). Use for UDFs with
+    expensive per-process setup — the pool autoscales between min_size
+    and max_size on backlog."""
+
+    def __init__(
+        self,
+        min_size: int = 1,
+        max_size: int = 4,
+        *,
+        max_tasks_per_actor: int = 2,
+        num_cpus: float = 1.0,
+    ):
+        if min_size < 1 or max_size < min_size:
+            raise ValueError(
+                f"bad pool bounds [{min_size}, {max_size}]"
+            )
+        self.min_size = min_size
+        self.max_size = max_size
+        self.max_tasks_per_actor = max_tasks_per_actor
+        self.num_cpus = num_cpus
+
+
 class Dataset:
-    def __init__(self, stages: List[Stage], window: int = 8):
+    def __init__(
+        self,
+        stages: List[Stage],
+        window: int = 8,
+        inflight_bytes: Optional[int] = None,
+    ):
         self._stages = stages
         self._window = window
+        self._inflight_bytes = inflight_bytes
         self._materialized: Optional[List[Any]] = None  # block refs
 
     # -- plan building -------------------------------------------------
     def _with(self, stage: Stage) -> "Dataset":
-        return Dataset(self._stages + [stage], self._window)
+        return Dataset(
+            self._stages + [stage], self._window, self._inflight_bytes
+        )
+
+    def options(
+        self,
+        *,
+        window: Optional[int] = None,
+        inflight_bytes: Optional[int] = None,
+    ) -> "Dataset":
+        """Execution knobs: per-stage in-flight task window and byte
+        budget (reference: ExecutionOptions / DataContext resource
+        limits)."""
+        return Dataset(
+            self._stages,
+            window if window is not None else self._window,
+            inflight_bytes
+            if inflight_bytes is not None
+            else self._inflight_bytes,
+        )
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._with(
@@ -74,20 +125,48 @@ class Dataset:
         *,
         batch_size: Optional[int] = None,
         batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: tuple = (),
     ) -> "Dataset":
-        def apply(block: Block) -> Block:
-            out: Block = []
-            slices = (
-                iter_slices(block, batch_size)
-                if batch_size
-                else [block]
-            )
-            for rows in slices:
-                result = fn(format_batch(rows, batch_format))
-                out.extend(batch_to_rows(result))
-            return out
+        """Per-batch transform. `fn` may be a callable CLASS when
+        `compute=ActorPoolStrategy(...)`: each pool actor instantiates
+        it once (with fn_constructor_args) and reuses the instance for
+        every batch — the warm-state UDF pattern (reference:
+        dataset.py map_batches(compute=ActorPoolStrategy))."""
 
-        return self._with(MapStage(apply, "map_batches"))
+        def make_apply(udf):
+            def apply(block: Block) -> Block:
+                out: Block = []
+                slices = (
+                    iter_slices(block, batch_size)
+                    if batch_size
+                    else [block]
+                )
+                for rows in slices:
+                    result = udf(format_batch(rows, batch_format))
+                    out.extend(batch_to_rows(result))
+                return out
+
+            return apply
+
+        if compute is not None:
+            return self._with(
+                ActorPoolStage(
+                    fn,
+                    make_apply,
+                    ctor_args=tuple(fn_constructor_args),
+                    min_size=compute.min_size,
+                    max_size=compute.max_size,
+                    max_tasks_per_actor=compute.max_tasks_per_actor,
+                    num_cpus=compute.num_cpus,
+                    name="map_batches(actors)",
+                )
+            )
+        if isinstance(fn, type):
+            raise ValueError(
+                "class UDFs require compute=ActorPoolStrategy(...)"
+            )
+        return self._with(MapStage(make_apply(fn), "map_batches"))
 
     def add_column(self, name: str, fn: Callable[[dict], Any]) -> "Dataset":
         return self.map(lambda row: {**row, name: fn(row)})
@@ -190,7 +269,11 @@ class Dataset:
     def union(self, other: "Dataset") -> "Dataset":
         def run(refs):
             return refs + list(
-                execute_streaming(other._stages, other._window)
+                execute_streaming(
+                    other._stages,
+                    other._window,
+                    other._inflight_bytes,
+                )
             )
 
         return self._with(AllToAllStage(run, "union"))
@@ -199,14 +282,18 @@ class Dataset:
     def _block_refs(self) -> List[Any]:
         if self._materialized is None:
             self._materialized = list(
-                execute_streaming(self._stages, self._window)
+                execute_streaming(
+                    self._stages, self._window, self._inflight_bytes
+                )
             )
         return self._materialized
 
     def iter_block_refs(self) -> Iterator[Any]:
         if self._materialized is not None:
             return iter(self._materialized)
-        return execute_streaming(self._stages, self._window)
+        return execute_streaming(
+            self._stages, self._window, self._inflight_bytes
+        )
 
     def materialize(self) -> "Dataset":
         self._block_refs()
@@ -300,7 +387,7 @@ class Dataset:
         Train workers."""
         coordinator_cls = rt.remote(num_cpus=0)(_SplitCoordinator)
         coordinator = coordinator_cls.remote(
-            self._stages, self._window, n, equal
+            self._stages, self._window, n, equal, self._inflight_bytes
         )
         return [DataIterator(coordinator, i) for i in range(n)]
 
@@ -400,8 +487,8 @@ class _SplitCoordinator:
     equal=True enforces strict round-robin; otherwise first-come-first-
     served (reference: output_splitter.py)."""
 
-    def __init__(self, stages, window, n, equal):
-        self._iter = execute_streaming(stages, window)
+    def __init__(self, stages, window, n, equal, inflight_bytes=None):
+        self._iter = execute_streaming(stages, window, inflight_bytes)
         self._n = n
         self._equal = equal
         self._queues: List[List[Block]] = [[] for _ in range(n)]
