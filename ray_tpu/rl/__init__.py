@@ -1,9 +1,12 @@
 """Reinforcement learning (reference: rllib/ new API stack —
 EnvRunnerGroup + Learner + Algorithm)."""
 
+from .actor_manager import CallResult, FaultTolerantActorManager
+from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env import ENV_REGISTRY, CartPoleEnv, VectorEnv, make_env
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from .learner import JaxLearner
+from .learner_group import LearnerGroup
 from .ppo import PPO, PPOConfig
 
 __all__ = [
@@ -13,7 +16,13 @@ __all__ = [
     "make_env",
     "SingleAgentEnvRunner",
     "EnvRunnerGroup",
+    "FaultTolerantActorManager",
+    "CallResult",
     "JaxLearner",
+    "LearnerGroup",
     "PPO",
     "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
 ]

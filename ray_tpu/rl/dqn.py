@@ -1,0 +1,325 @@
+"""DQN algorithm.
+
+Reference: rllib/algorithms/dqn/ — dqn.py (Algorithm.training_step:
+sample -> replay buffer -> minibatch TD updates -> periodic target-net
+sync) + dqn_rainbow_learner.py (Huber TD loss against a frozen target
+network) + utils/replay_buffers/. TPU-native form: the Q-function is
+the same pure-functional MLP the policy stack uses (models.py), the
+TD update is one jitted step, and the replay buffer is preallocated
+numpy rings (no per-transition Python objects).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .env import VectorEnv, make_env
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer (reference:
+    utils/replay_buffers/replay_buffer.py, storage_unit=timesteps)."""
+
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._next = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(
+        self, obs, actions, rewards, next_obs, dones
+    ) -> None:
+        for i in range(len(actions)):
+            j = self._next
+            self.obs[j] = obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.next_obs[j] = next_obs[i]
+            self.dones[j] = float(dones[i])
+            self._next = (self._next + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class DQNConfig:
+    """Fluent builder (reference: DQNConfig(AlgorithmConfig))."""
+
+    def __init__(self):
+        self.env_spec: Any = "CartPole-v1"
+        self.num_envs = 8
+        self.rollout_length = 64  # vector steps per train() iteration
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.buffer_capacity = 50_000
+        self.train_batch_size = 64
+        self.num_updates_per_iteration = 128
+        self.learning_starts = 1_000  # transitions before updates
+        # Updates between target syncs. Too-frequent syncing collapses
+        # CartPole (measured: freq 8 plateaus at return ~10; freq 100
+        # reaches 130+ by ~30k steps).
+        self.target_update_freq = 100
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 8_000  # transitions to anneal over
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.double_q = True
+
+    def environment(self, env) -> "DQNConfig":
+        self.env_spec = env
+        return self
+
+    def env_runners(
+        self,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+    ) -> "DQNConfig":
+        if num_envs_per_env_runner is not None:
+            self.num_envs = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_length = rollout_fragment_length
+        return self
+
+    def training(
+        self,
+        lr: Optional[float] = None,
+        gamma: Optional[float] = None,
+        train_batch_size: Optional[int] = None,
+        target_network_update_freq: Optional[int] = None,
+        num_steps_sampled_before_learning_starts: Optional[int] = None,
+        double_q: Optional[bool] = None,
+    ) -> "DQNConfig":
+        for name, value in (
+            ("lr", lr),
+            ("gamma", gamma),
+            ("train_batch_size", train_batch_size),
+            ("target_update_freq", target_network_update_freq),
+            ("learning_starts", num_steps_sampled_before_learning_starts),
+            ("double_q", double_q),
+        ):
+            if value is not None:
+                setattr(self, name, value)
+        return self
+
+    def debugging(self, seed: Optional[int] = None) -> "DQNConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """(reference: dqn.py DQN(Algorithm) — train()/save/restore)."""
+
+    def __init__(self, config: DQNConfig):
+        import jax
+        import optax
+
+        from .models import init_policy_params
+
+        self.config = config
+        probe = make_env(config.env_spec, seed=0)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        key = jax.random.PRNGKey(config.seed)
+        # The pi head doubles as the Q head (A outputs); vf unused.
+        self.params = init_policy_params(
+            key, self.obs_size, self.num_actions, config.hidden
+        )
+        self.target_params = jax.device_get(self.params)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, self.obs_size, seed=config.seed
+        )
+        self.vec = VectorEnv(
+            lambda s: make_env(config.env_spec, seed=s),
+            config.num_envs,
+            seed=config.seed,
+        )
+        self._obs = self.vec.reset()
+        self._rng = np.random.default_rng(config.seed)
+        self._update_jit = jax.jit(self._td_update)
+        self._q_jit = jax.jit(self._q_values)
+        self.iteration = 0
+        self.env_steps = 0
+        self.updates = 0
+        self._ep_returns = np.zeros(config.num_envs)
+        self._recent_returns: list = []
+
+    # -- Q function ----------------------------------------------------
+    @staticmethod
+    def _q_values(params, obs):
+        from .models import apply_policy
+
+        q, _ = apply_policy(params, obs)
+        return q
+
+    def _td_update(self, params, target_params, opt_state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma = self.config.gamma
+
+        def loss_fn(p):
+            q = self._q_values(p, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1
+            )[:, 0]
+            q_next_target = self._q_values(
+                target_params, batch["next_obs"]
+            )
+            if self.config.double_q:
+                # Double-DQN: online net picks, target net evaluates
+                # (reference: dqn_rainbow_learner.py double_q branch).
+                q_next_online = self._q_values(p, batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=1)
+            else:
+                best = jnp.argmax(q_next_target, axis=1)
+            next_value = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=1
+            )[:, 0]
+            td_target = batch["rewards"] + gamma * next_value * (
+                1.0 - batch["dones"]
+            )
+            td_target = jax.lax.stop_gradient(td_target)
+            return jnp.mean(
+                optax.huber_loss(q_taken, td_target)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # -- acting --------------------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / cfg.epsilon_decay_steps)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial
+        )
+
+    def _act(self, obs: np.ndarray) -> np.ndarray:
+        eps = self._epsilon()
+        greedy = np.asarray(
+            np.argmax(self._q_jit(self.params, obs), axis=1)
+        )
+        explore = self._rng.integers(
+            0, self.num_actions, size=len(obs)
+        )
+        coin = self._rng.random(len(obs)) < eps
+        return np.where(coin, explore, greedy).astype(np.int32)
+
+    # -- one iteration (reference: DQN.training_step) -----------------
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        for _ in range(cfg.rollout_length):
+            actions = self._act(self._obs)
+            next_obs, rewards, terminated, truncated = self.vec.step(
+                actions
+            )
+            self.buffer.add_batch(
+                self._obs, actions, rewards, next_obs, terminated
+            )
+            self.env_steps += len(actions)
+            self._ep_returns += rewards
+            for i in range(len(actions)):
+                if terminated[i] or truncated[i]:
+                    self._recent_returns.append(
+                        float(self._ep_returns[i])
+                    )
+                    self._ep_returns[i] = 0.0
+            self._obs = next_obs
+        loss = float("nan")
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                device_batch = {
+                    k: np.asarray(v) for k, v in batch.items()
+                }
+                self.params, self.opt_state, loss = self._update_jit(
+                    self.params,
+                    self.target_params,
+                    self.opt_state,
+                    device_batch,
+                )
+                self.updates += 1
+                if self.updates % cfg.target_update_freq == 0:
+                    self.target_params = jax.device_get(self.params)
+            loss = float(loss)
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_return = (
+            float(np.mean(self._recent_returns))
+            if self._recent_returns
+            else float("nan")
+        )
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_return,
+            "num_env_steps_sampled": self.env_steps,
+            "num_updates": self.updates,
+            "epsilon": self._epsilon(),
+            "td_loss": loss,
+        }
+
+    # -- checkpointing (reference: Algorithm.save/restore) ------------
+    def save(self, path: Optional[str] = None) -> str:
+        import jax
+
+        path = path or tempfile.mkdtemp(prefix="rt_dqn_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "params": jax.device_get(self.params),
+                    "target_params": self.target_params,
+                    "iteration": self.iteration,
+                    "env_steps": self.env_steps,
+                    "updates": self.updates,
+                },
+                f,
+            )
+        return path
+
+    def restore(self, path: str) -> None:
+        import jax
+
+        with open(os.path.join(path, "state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.device_put(state["params"])
+        self.target_params = state["target_params"]
+        self.iteration = state["iteration"]
+        self.env_steps = state["env_steps"]
+        self.updates = state["updates"]
+
+    def stop(self) -> None:
+        pass

@@ -48,6 +48,9 @@ class SingleAgentEnvRunner:
         self.params = params
         return True
 
+    def ping(self) -> str:
+        return "ok"
+
     def sample(self) -> Dict[str, np.ndarray]:
         from .models import sample_actions
 
@@ -114,8 +117,12 @@ class SingleAgentEnvRunner:
 
 
 class EnvRunnerGroup:
-    """Fan-out over runner actors (reference: env_runner_group.py
-    sample + weight sync)."""
+    """Fault-tolerant fan-out over runner actors (reference:
+    env_runner_group.py sample + weight sync, with
+    rllib/utils/actor_manager.py:198 FaultTolerantActorManager
+    underneath: a runner dying mid-iteration costs its shard of the
+    sample, never the iteration; the dead slot is respawned and
+    re-synced on the next sample)."""
 
     def __init__(
         self,
@@ -129,10 +136,14 @@ class EnvRunnerGroup:
     ):
         import ray_tpu as rt
 
+        from .actor_manager import FaultTolerantActorManager
+
         self._rt = rt
+        self._latest_weights_ref = None
         runner_cls = rt.remote(num_cpus=1)(SingleAgentEnvRunner)
-        self.runners = [
-            runner_cls.remote(
+
+        def make_runner(i: int):
+            return runner_cls.remote(
                 env_spec,
                 num_envs_per_runner,
                 rollout_length,
@@ -140,28 +151,54 @@ class EnvRunnerGroup:
                 gae_lambda,
                 seed + 1000 * i,
             )
-            for i in range(num_env_runners)
+
+        def restore_runner(_idx: int, handle) -> None:
+            # A respawned runner holds no policy: re-sync before it
+            # samples (reference: restored-worker weight sync).
+            if self._latest_weights_ref is not None:
+                rt.get(
+                    handle.set_weights.remote(
+                        self._latest_weights_ref
+                    ),
+                    timeout=120,
+                )
+
+        self.manager = FaultTolerantActorManager(
+            [make_runner(i) for i in range(num_env_runners)],
+            actor_factory=make_runner,
+            on_restore=restore_runner,
+        )
+
+    @property
+    def runners(self) -> List:
+        return [
+            self.manager.actor(idx)
+            for idx in sorted(self.manager._actors)
         ]
 
+    def num_healthy_runners(self) -> int:
+        return self.manager.num_healthy_actors()
+
     def sync_weights(self, params) -> None:
-        ref = self._rt.put(params)
-        self._rt.get(
-            [r.set_weights.remote(ref) for r in self.runners],
-            timeout=120,
+        self._latest_weights_ref = self._rt.put(params)
+        self.manager.foreach_actor(
+            "set_weights", self._latest_weights_ref, timeout=120
         )
 
     def sample(self) -> Dict[str, np.ndarray]:
-        batches = self._rt.get(
-            [r.sample.remote() for r in self.runners], timeout=300
-        )
+        # Heal dead slots from previous iterations first, then accept
+        # whatever the healthy set returns this round.
+        self.manager.probe_unhealthy_actors()
+        results = self.manager.foreach_actor("sample", timeout=300)
+        batches = self.manager.ok_values(results)
+        if not batches:
+            raise RuntimeError(
+                "all env runners failed this iteration"
+            )
         return {
             key: np.concatenate([b[key] for b in batches])
             for key in batches[0]
         }
 
     def shutdown(self) -> None:
-        for runner in self.runners:
-            try:
-                self._rt.kill(runner)
-            except Exception:
-                pass
+        self.manager.shutdown()

@@ -55,6 +55,17 @@ class JaxLearner:
         self.num_epochs = num_epochs
         self._rng = np.random.default_rng(seed)
         self._update_jit = jax.jit(self._minibatch_update)
+        # Split-phase entry points for the multi-learner path
+        # (learner_group.py): gradients computed per shard, applied
+        # identically everywhere after averaging (reference:
+        # learner.py compute_gradients/apply_gradients split,
+        # torch_learner.py:171,192).
+        self._grad_jit = jax.jit(
+            lambda params, batch: jax.value_and_grad(
+                self._loss, has_aux=True
+            )(params, batch)
+        )
+        self._apply_jit = jax.jit(self._apply_gradients)
 
     # -- PPO loss (reference: ppo_torch_learner compute_loss) ---------
     def _loss(self, params, batch):
@@ -94,6 +105,22 @@ class JaxLearner:
         params = optax.apply_updates(params, updates)
         metrics = dict(metrics, total_loss=loss)
         return params, opt_state, metrics
+
+    def _apply_gradients(self, params, opt_state, grads):
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    # -- split-phase API (multi-learner) ------------------------------
+    def compute_gradients(self, minibatch) -> Tuple[Dict, Dict]:
+        """Gradients of the PPO loss on one (already device-ready)
+        minibatch; params unchanged."""
+        (loss, metrics), grads = self._grad_jit(self.params, minibatch)
+        return grads, dict(metrics, total_loss=loss)
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_jit(
+            self.params, self.opt_state, grads
+        )
 
     # -- public --------------------------------------------------------
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:

@@ -38,6 +38,7 @@ class PPOConfig:
         self.num_epochs = 4
         self.hidden = (64, 64)
         self.seed = 0
+        self.num_learners = 1
 
     def environment(self, env) -> "PPOConfig":
         self.env_spec = env
@@ -80,6 +81,17 @@ class PPOConfig:
                 setattr(self, name, value)
         return self
 
+    def learners(
+        self, num_learners: Optional[int] = None
+    ) -> "PPOConfig":
+        """Data-parallel learner count (reference:
+        AlgorithmConfig.learners(num_learners=...)). 1 = in-process
+        JaxLearner (whole-mesh GSPMD); >1 = LearnerGroup actors with
+        per-minibatch gradient all-reduce."""
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
     def debugging(self, seed: Optional[int] = None) -> "PPOConfig":
         if seed is not None:
             self.seed = seed
@@ -95,9 +107,9 @@ class PPO:
     def __init__(self, config: PPOConfig):
         self.config = config
         probe = make_env(config.env_spec, seed=0)
-        self.learner = JaxLearner(
-            probe.observation_size,
-            probe.num_actions,
+        learner_kwargs = dict(
+            obs_size=probe.observation_size,
+            num_actions=probe.num_actions,
             lr=config.lr,
             clip_eps=config.clip_eps,
             vf_coef=config.vf_coef,
@@ -107,6 +119,14 @@ class PPO:
             hidden=config.hidden,
             seed=config.seed,
         )
+        if config.num_learners > 1:
+            from .learner_group import LearnerGroup
+
+            self.learner = LearnerGroup(
+                config.num_learners, **learner_kwargs
+            )
+        else:
+            self.learner = JaxLearner(**learner_kwargs)
         self.env_runners = EnvRunnerGroup(
             config.env_spec,
             num_env_runners=config.num_env_runners,
@@ -164,3 +184,6 @@ class PPO:
 
     def stop(self) -> None:
         self.env_runners.shutdown()
+        shutdown = getattr(self.learner, "shutdown", None)
+        if shutdown is not None:
+            shutdown()
