@@ -187,6 +187,26 @@ class NativeArena:
         # including while the same thread holds the lock.
         self._call_lock = threading.RLock()
 
+    @classmethod
+    def attach(cls, path: str) -> "NativeArena":
+        """Attach to ANOTHER process's arena file (same host), sizing
+        the mapping from the creator's on-disk header — the attacher
+        need not know the creator's capacity/num_slots config. Used by
+        the daemon's same-host object-transfer fast path (plasma
+        analog: clients mmap the store and read under a pin)."""
+        import struct
+
+        with open(path, "rb") as f:
+            header = f.read(40)  # magic,capacity,used,lru_clock,slots
+        if len(header) < 40:
+            raise RuntimeError(f"truncated arena header: {path}")
+        magic, capacity, _used, _clock, num_slots = struct.unpack(
+            "<QQQQI", header[:36]
+        )
+        if magic != 0x5254535052455632:  # store.cc kMagic
+            raise RuntimeError(f"not an arena file: {path}")
+        return cls(path, capacity, num_slots=num_slots, create=False)
+
     @staticmethod
     def _key(oid: bytes) -> bytes:
         if len(oid) > OID_BYTES:

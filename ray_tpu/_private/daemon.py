@@ -78,6 +78,18 @@ SEALED = "SEALED"
 ERRORED = "ERRORED"
 
 
+def _oob_chunk(chunk: bytes):
+    """Wrap an object-transfer chunk so the wire layer ships it as a
+    pickle-5 out-of-band buffer: the sender scatter-gathers it straight
+    from this memory and the receiver reconstructs a zero-copy view of
+    its receive buffer (numpy implements the PickleBuffer protocol;
+    raw bytes would be copied back in at load). The puller's
+    `buf[off:off+n] = data` assignment accepts the array view as-is."""
+    import numpy as np
+
+    return np.frombuffer(chunk, dtype=np.uint8)
+
+
 @dataclass
 class ObjectEntry:
     state: str = PENDING
@@ -206,6 +218,12 @@ class NodeDaemon:
         self.workers: Dict[int, WorkerInfo] = {}  # conn_id -> info
         self.drivers: Dict[int, JobID] = {}  # conn_id -> job
         self._spawning = 0
+        self._spawn_watchlist: list = []
+        self._spawn_watch_lock = threading.Lock()
+        self._spawn_watcher: Optional[threading.Thread] = None
+        #: Same-host peers' arenas attached for shm-copy pulls,
+        #: keyed by arena path (see _pull_same_host).
+        self._peer_arenas: Dict[str, object] = {}
         self._fork_server = None  # warm worker template (lazy)
         self._fork_server_lock = threading.Lock()
         # Worker spawns run on a dedicated thread: the fork-server
@@ -1186,7 +1204,7 @@ class NodeDaemon:
                 )
             finally:
                 pin.release()
-            return {"data": chunk, "total_size": total}
+            return {"data": _oob_chunk(chunk), "total_size": total}
         view = self.store.get(oid, timeout=0.1)
         if view is None and size is not None:
             # Segment was created directly by a local worker process;
@@ -1199,7 +1217,7 @@ class NodeDaemon:
             return self._pull_from_spill(oid, offset, length)
         total = len(view)
         chunk = bytes(view[offset : min(offset + length, total)])
-        return {"data": chunk, "total_size": total}
+        return {"data": _oob_chunk(chunk), "total_size": total}
 
     def _pull_from_spill(self, oid: ObjectID, offset: int, length: int):
         """Serve a pull chunk straight from this node's spill file —
@@ -1208,7 +1226,7 @@ class NodeDaemon:
             data = self.spill.read(oid, offset, length)
             total = self.spill.size(oid)
             if data is not None and total is not None:
-                return {"data": data, "total_size": total}
+                return {"data": _oob_chunk(data), "total_size": total}
         return {"missing": True}
 
     def _h_delete_object(self, conn, msg):
@@ -1836,13 +1854,17 @@ class NodeDaemon:
             import random as _random
 
             nid, addr = _random.choice(locations)
-            client = (
-                self._node_client(nid) if self.is_head
-                else self._peer_client(addr)
-            )
-            if client is None:
-                continue
-            if self._pull_chunks(client, oid, size):
+            if self._pull_same_host(nid, oid, size):
+                pulled = True
+            else:
+                client = (
+                    self._node_client(nid) if self.is_head
+                    else self._peer_client(addr)
+                )
+                if client is None:
+                    continue
+                pulled = self._pull_chunks(client, oid, size)
+            if pulled:
                 with self._lock:
                     entry = self._ensure_entry(oid)
                     entry.in_shm = True
@@ -1861,6 +1883,56 @@ class NodeDaemon:
                 return
         # Exhausted retries: leave waiters armed; a future seal or
         # location report re-wakes them.
+
+    def _pull_same_host(
+        self, src_nid: bytes, oid: ObjectID, size: int
+    ) -> bool:
+        """Same-host transfer: attach the source daemon's shared
+        arena and copy the slot under a pin — one memcpy, no sockets
+        (reference: plasma hands same-host clients the store mmap and
+        only the object manager moves bytes over the network,
+        object_manager/object_manager.h; two daemons on one host are
+        'network peers' only in topology, not in memory). Falls back
+        to chunked socket pulls when the source's arena file isn't on
+        this machine, the store isn't the native arena, or the object
+        vanished (eviction race)."""
+        if not getattr(self.store, "needs_release", False):
+            return False  # py store: per-object segments, socket path
+        path = f"/dev/shm/rt_arena_{NodeID(src_nid).hex()[:8]}"
+        if not os.path.exists(path):
+            return False  # different host (or source gone)
+        from .object_store import ArenaPin
+
+        try:
+            arena = self._peer_arenas.get(path)
+            if arena is None:
+                from .._native import NativeArena
+
+                arena = NativeArena.attach(path)
+                self._peer_arenas[path] = arena
+            pinned = arena.try_pin(oid.binary())
+        except Exception:
+            return False
+        if pinned is None:
+            return False  # evicted at the source: retry via meta
+        index, view = pinned
+        pin = ArenaPin(arena, view, index)
+        try:
+            if len(view) != size:
+                return False  # stale metadata; let the socket path sort it
+            if self.store.contains(oid):
+                return True
+            try:
+                buf = self.store.create(oid, size)
+            except ValueError:
+                return True  # concurrent pull won
+            except Exception:
+                return False
+            buf[:size] = view
+            self.store.seal(oid)
+            return True
+        finally:
+            pin.release()
 
     def _pull_chunks(self, client: RpcClient, oid: ObjectID, size: int) -> bool:
         """Transfer one object with a WINDOW of chunk requests in
@@ -1927,7 +1999,8 @@ class NodeDaemon:
                         if (
                             reply.get("_error")
                             or reply.get("missing")
-                            or not data
+                            or data is None
+                            or len(data) == 0
                         ):
                             state["err"] = reply.get(
                                 "_error", "source missing object/chunk"
@@ -3548,34 +3621,65 @@ class NodeDaemon:
         """Detect workers that die before registering (bad env, import
         error) so their spawn slot is reclaimed and the failure is
         surfaced instead of hanging the queue (reference: WorkerPool
-        PopWorker failure callbacks, worker_pool.cc:1312)."""
+        PopWorker failure callbacks, worker_pool.cc:1312).
 
-        def watch():
-            deadline = time.time() + 30
-            while time.time() < deadline:
-                if proc.poll() is not None:
-                    with self._lock:
-                        registered = any(
-                            w.pid == proc.pid for w in self.workers.values()
-                        )
-                        if not registered:
+        ONE watcher thread serves all pending spawns: a thread per
+        spawn, each scanning the workers dict on its own 0.2s tick,
+        was O(spawns x workers) of pure poll overhead at the
+        1000-actor scale."""
+        with self._spawn_watch_lock:
+            self._spawn_watchlist.append((proc, time.time() + 30))
+            if self._spawn_watcher is None or not (
+                self._spawn_watcher.is_alive()
+            ):
+                self._spawn_watcher = threading.Thread(
+                    target=self._spawn_watch_loop, daemon=True,
+                    name="spawn-watch",
+                )
+                self._spawn_watcher.start()
+
+    def _spawn_watch_loop(self) -> None:
+        while True:
+            with self._spawn_watch_lock:
+                watched = list(self._spawn_watchlist)
+            if not watched:
+                with self._spawn_watch_lock:
+                    if not self._spawn_watchlist:
+                        self._spawn_watcher = None
+                        return
+                time.sleep(0.05)
+                continue
+            with self._lock:
+                live_pids = {w.pid for w in self.workers.values()}
+            now = time.time()
+            done = []
+            for proc, deadline in watched:
+                if proc.pid in live_pids:
+                    done.append((proc, deadline))
+                    continue
+                exited = proc.poll() is not None
+                if exited or now > deadline:
+                    done.append((proc, deadline))
+                    if exited:
+                        with self._lock:
                             self._spawning = max(0, self._spawning - 1)
                             self._spawn_failures += 1
                             failures = self._spawn_failures
-                    if not registered and failures >= 3:
-                        self._fail_all_queued(
-                            "worker processes are crashing at startup; "
-                            f"see {self.session_dir}/worker-*.out"
-                        )
-                    self._schedule()
-                    return
-                if any(
-                    w.pid == proc.pid for w in list(self.workers.values())
-                ):
-                    return
-                time.sleep(0.2)
-
-        threading.Thread(target=watch, daemon=True).start()
+                        if failures >= 3:
+                            self._fail_all_queued(
+                                "worker processes are crashing at "
+                                "startup; see "
+                                f"{self.session_dir}/worker-*.out"
+                            )
+                        self._schedule()
+            if done:
+                with self._spawn_watch_lock:
+                    for item in done:
+                        try:
+                            self._spawn_watchlist.remove(item)
+                        except ValueError:
+                            pass
+            time.sleep(0.2)
 
     def _fail_all_queued(self, detail: str) -> None:
         with self._lock:
@@ -3955,6 +4059,14 @@ class NodeDaemon:
                 client.close()
             except Exception:
                 pass
+        # Detach (never unlink) peers' arenas: the files belong to
+        # their daemons.
+        for arena in self._peer_arenas.values():
+            try:
+                arena.close(unlink=False)
+            except Exception:
+                pass
+        self._peer_arenas.clear()
         self.server.close()
         # Reclaim every live shared-memory object of the session.
         with self._lock:

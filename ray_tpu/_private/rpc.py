@@ -62,7 +62,8 @@ from .wire import (
     PROTOCOL_VERSION,
     ProtocolVersionError,
     decode_frame,
-    encode_frame,
+    encode_frame,  # noqa: F401 — contiguous-frame path for tests
+    encode_frame_buffers,
 )
 from .wire import validate as _schema_validate
 
@@ -184,13 +185,56 @@ def _chaos_should_fail(method: str) -> bool:
 # framing
 # ---------------------------------------------------------------------------
 
-def send_msg(sock: socket.socket, msg: dict, key: bytes) -> None:
-    payload = encode_frame(msg)
-    digest = _hmac.new(key, payload, hashlib.sha256).digest()
+_ZERO_DIGEST = b"\x00" * _DIGEST_BYTES
+
+
+def _frame_mac(sock: socket.socket) -> bool:
+    """Per-frame MAC policy: required on TCP (network peers), elided
+    on AF_UNIX — same-host sockets are gated by session-dir file
+    permissions and the connection handshake still proves key
+    possession, so hashing every multi-megabyte object chunk twice
+    per hop bought no security the kernel wasn't already providing
+    (the reference's local gRPC plane runs plaintext for the same
+    reason). The digest field stays in the layout (zero-filled) so
+    framing is family-independent."""
     try:
-        sock.sendall(_LEN.pack(len(payload)) + digest + payload)
+        return sock.family != socket.AF_UNIX
+    except Exception:
+        return True
+
+
+def send_msg(sock: socket.socket, msg: dict, key: bytes) -> None:
+    buffers = encode_frame_buffers(msg)
+    total = sum(len(b) for b in buffers)
+    if _frame_mac(sock):
+        mac = _hmac.new(key, None, hashlib.sha256)
+        for buf in buffers:
+            mac.update(buf)
+        digest = mac.digest()
+    else:
+        digest = _ZERO_DIGEST
+    try:
+        # Scatter-gather: object-chunk payloads go from their source
+        # buffer to the kernel with no user-space concatenation.
+        _sendall_vectored(
+            sock, [_LEN.pack(total) + digest, *buffers]
+        )
     except (BrokenPipeError, ConnectionResetError, OSError) as e:
         raise ConnectionLost(str(e)) from e
+
+
+def _sendall_vectored(sock: socket.socket, buffers: list) -> None:
+    views = [memoryview(b).cast("B") for b in buffers if len(b)]
+    while views:
+        sent = sock.sendmsg(views)
+        while sent > 0 and views:
+            head = views[0]
+            if sent >= len(head):
+                sent -= len(head)
+                views.pop(0)
+            else:
+                views[0] = head[sent:]
+                sent = 0
 
 
 def recv_msg(sock: socket.socket, key: bytes) -> Optional[dict]:
@@ -198,16 +242,17 @@ def recv_msg(sock: socket.socket, key: bytes) -> Optional[dict]:
     if header is None:
         return None
     (length,) = _LEN.unpack(header[: _LEN.size])
-    digest = header[_LEN.size:]
+    digest = bytes(header[_LEN.size:])
     if length > _MAX_FRAME:  # enforced before buffering anything
         return None
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
-    expect = _hmac.new(key, payload, hashlib.sha256).digest()
-    if not _hmac.compare_digest(digest, expect):
-        # Unauthenticated frame: never reaches the decoder.
-        return None
+    if _frame_mac(sock):
+        expect = _hmac.new(key, payload, hashlib.sha256).digest()
+        if not _hmac.compare_digest(digest, expect):
+            # Unauthenticated frame: never reaches the decoder.
+            return None
     try:
         return decode_frame(payload)
     except Exception:
@@ -216,18 +261,21 @@ def recv_msg(sock: socket.socket, key: bytes) -> Optional[dict]:
         return None
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n > 0:
+def _recv_exact(sock: socket.socket, n: int):
+    """Receive exactly n bytes into one preallocated buffer (the
+    recv-append-join loop this replaces copied every chunk twice)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         try:
-            chunk = sock.recv(min(n, 1 << 20))
+            r = sock.recv_into(view[got:], min(n - got, 1 << 20))
         except (ConnectionResetError, OSError):
             return None
-        if not chunk:
+        if r == 0:
             return None
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
 # ---------------------------------------------------------------------------

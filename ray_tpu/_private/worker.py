@@ -826,7 +826,14 @@ class CoreWorker:
             "pg_context": pg_context,
             "runtime_env": runtime_env,
         }
-        self._client.call("create_actor", spec=spec)
+        # One-way: the reply is always {} (creation errors surface
+        # through actor state / the creation task's return object),
+        # and frames on one connection process in order, so a
+        # same-connection method submit can never overtake its
+        # create. Pipelining the creates instead of paying one
+        # driver->head round trip each is worth ~7ms/actor at the
+        # 1000-actor scale.
+        self._client.notify("create_actor", spec=spec)
         return actor_id
 
     def submit_actor_task(

@@ -281,6 +281,23 @@ def main() -> None:
     # rpc/wire (protobuf) -> object_store (numpy) -> serialization.
     from . import worker_main  # noqa: F401
 
+    # Modules the worker pulls LAZILY (first CoreWorker init / first
+    # task) import here instead — measured at ~0.25s of post-fork CPU
+    # per child without this (runtime_env -> zipfile/pathlib, plus the
+    # native store's ctypes dlopen), which dominated actor-creation
+    # throughput on small hosts. dlopen'd libraries and compiled
+    # bytecode are inherited copy-on-write; loading the .so here is
+    # safe (no store ATTACH — fds stay per-child).
+    from . import runtime_env  # noqa: F401
+    from . import accelerators  # noqa: F401
+
+    try:
+        from .._native import load_library
+
+        load_library()
+    except Exception:
+        pass  # native store disabled/unbuilt: children fall back too
+
     threading.Thread(target=_reaper, daemon=True).start()
     out_fd = sys.stdout.fileno()
     # Signal readiness so the daemon can distinguish "template still

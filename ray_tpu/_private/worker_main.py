@@ -10,6 +10,27 @@ import sys
 
 def main() -> None:
     socket_path = os.environ["RT_SOCKET"]
+    profile_dir = os.environ.get("RT_WORKER_PROFILE")
+    prof = None
+    if profile_dir:
+        # Startup-cost diagnosis: profile the first 2s (init + first
+        # task) and dump; fork-server children skip interpreter
+        # finalization, so a timer flush is the only reliable exit.
+        import cProfile
+        import threading
+
+        prof = cProfile.Profile()
+        prof.enable()
+
+        def _dump():
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(
+                    profile_dir, f"worker-{os.getpid()}.prof"
+                )
+            )
+
+        threading.Timer(2.0, _dump).start()
     from .worker import CoreWorker, set_global_worker
 
     worker = CoreWorker(socket_path, role="worker")
