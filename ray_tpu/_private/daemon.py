@@ -184,7 +184,14 @@ class NodeDaemon:
         self.is_head = is_head
         self.node_id = NodeID.from_random()
         self.socket_path = os.path.join(session_dir, "hostd.sock")
-        os.makedirs(session_dir, exist_ok=True)
+        os.makedirs(session_dir, mode=0o700, exist_ok=True)
+        try:
+            # exist_ok skips mode application on pre-existing dirs;
+            # the session dir's permissions gate unix-socket access
+            # (rpc.py _frame_mac), so enforce them regardless.
+            os.chmod(session_dir, 0o700)
+        except OSError:
+            pass
 
         capacity = config.object_store_memory or _default_store_bytes()
         self.store = make_store(
@@ -2347,7 +2354,18 @@ class NodeDaemon:
             class_name=spec.get("class_name", ""),
             max_restarts=spec.get("max_restarts", 0),
         )
-        self.control.register_actor(info)
+        try:
+            self.control.register_actor(info)
+        except Exception as e:
+            # Creates arrive as one-way notifies (pipelined), so a
+            # registration error (duplicate name) can't ride an RPC
+            # reply — it surfaces the way every other actor failure
+            # does: the creation task's return object seals with the
+            # error and the first method result raises it.
+            self._fail_task_returns(
+                spec, "ActorDiedError", f"actor registration failed: {e}"
+            )
+            return {}
         # Creation spec rides the op log so a restarted head can
         # rebuild this runtime record (and restart the actor if its
         # host later dies).

@@ -317,7 +317,20 @@ class RpcServer:
             if os.path.exists(path):
                 os.unlink(path)
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
+            # The kernel's file permissions ARE the auth layer on
+            # unix sockets (per-frame MACs are TCP-only, see
+            # _frame_mac): owner-only on both the socket and its
+            # directory, independent of the process umask.
+            old_umask = os.umask(0o077)
+            try:
+                sock.bind(path)
+            finally:
+                os.umask(old_umask)
+            try:
+                os.chmod(path, 0o600)
+                os.chmod(os.path.dirname(path) or ".", 0o700)
+            except OSError:
+                pass
             canonical = path
             self._unix_paths.append(path)
         else:

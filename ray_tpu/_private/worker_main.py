@@ -13,28 +13,24 @@ def main() -> None:
     profile_dir = os.environ.get("RT_WORKER_PROFILE")
     prof = None
     if profile_dir:
-        # Startup-cost diagnosis: profile the first 2s (init + first
-        # task) and dump; fork-server children skip interpreter
-        # finalization, so a timer flush is the only reliable exit.
+        # Startup-cost diagnosis: profile interpreter + CoreWorker
+        # init (imports, store attach, register) and dump BEFORE the
+        # task loop. Same-thread enable/disable only — cProfile hooks
+        # are per-thread, so a timer-thread disable would leave the
+        # main thread profiled (and slowed ~2x) forever.
         import cProfile
-        import threading
 
         prof = cProfile.Profile()
         prof.enable()
-
-        def _dump():
-            prof.disable()
-            prof.dump_stats(
-                os.path.join(
-                    profile_dir, f"worker-{os.getpid()}.prof"
-                )
-            )
-
-        threading.Timer(2.0, _dump).start()
     from .worker import CoreWorker, set_global_worker
 
     worker = CoreWorker(socket_path, role="worker")
     set_global_worker(worker)
+    if prof is not None:
+        prof.disable()
+        prof.dump_stats(
+            os.path.join(profile_dir, f"worker-{os.getpid()}.prof")
+        )
     try:
         worker.run_task_loop()
     finally:

@@ -216,12 +216,17 @@ class StandardAutoscaler:
                 if not placed:
                     unplaced.append(request)
             if unplaced:
-                # Homogeneous-gang launch sized by the largest bundle
-                # (slice bundles are uniform per-host chip sets).
-                biggest = max(
-                    unplaced, key=lambda b: sorted(b.items())
-                )
-                added = _launch_for(biggest, len(unplaced))
+                # Launch hosts each able to hold ANY of the unplaced
+                # bundles: size the per-host requirement as the
+                # elementwise max across bundles (slice gangs are
+                # uniform chip sets, but a heterogeneous STRICT_SPREAD
+                # must not pick a host shape that fits only one
+                # bundle kind).
+                need: Dict[str, float] = {}
+                for request in unplaced:
+                    for name, amount in request.items():
+                        need[name] = max(need.get(name, 0.0), amount)
+                added = _launch_for(need, len(unplaced))
                 if added:
                     for request, capacity in zip(unplaced, added):
                         _consume(capacity, request)

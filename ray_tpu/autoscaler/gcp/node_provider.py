@@ -78,6 +78,11 @@ class GcpTpuNodeProvider(NodeProvider):
         )
         self._lock = threading.Lock()
         self._seq = 0
+        # short node id -> rt-node-type, refreshed by every
+        # list_nodes; node_type() reads it instead of issuing one
+        # nodes.get per node per reconcile tick (an N+1 REST-call
+        # pattern against data the list already carried).
+        self._type_cache: Dict[str, str] = {}
 
     # -- capacity shape ------------------------------------------------
     def slice_hosts(self, node_type: str) -> int:
@@ -137,17 +142,29 @@ class GcpTpuNodeProvider(NodeProvider):
                 raise
 
     def _cluster_nodes(self) -> List[dict]:
-        return [
+        nodes = [
             n
             for n in self.client.list_nodes()
             if n.get("labels", {}).get(LABEL_CLUSTER) == self.cluster_name
             and n.get("state") not in ("DELETING", "TERMINATED")
         ]
+        with self._lock:
+            self._type_cache = {
+                n["name"].rsplit("/", 1)[1]: n.get("labels", {}).get(
+                    LABEL_NODE_TYPE
+                )
+                for n in nodes
+            }
+        return nodes
 
     def non_terminated_nodes(self) -> List[str]:
         return [n["name"].rsplit("/", 1)[1] for n in self._cluster_nodes()]
 
     def node_type(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            cached = self._type_cache.get(node_id)
+        if cached is not None:
+            return cached
         try:
             node = self.client.get_node(self._full_name(node_id))
         except GcpApiError:

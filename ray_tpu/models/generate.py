@@ -105,7 +105,9 @@ def _forward_with_cache(
     b, t = tokens.shape
     positions = cache_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
     x = params["embed"][tokens].astype(cfg.dtype)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     def body(carry, inputs):
         x = carry

@@ -20,10 +20,12 @@ so activations right-multiply):
   embed_tokens.weight       -> embed           [vocab, dim]
   lm_head.weight.T          -> lm_head         [dim, vocab]
 RoPE uses the same half-split (rotate_half) convention as HF; RMSNorm
-eps maps from hf_config.rms_norm_eps (Llama-2 ships 1e-5). Checkpoints
-carrying tensors with no slot here (biases, rope_scaling variants)
-fail the conversion loudly instead of converting into a numerically
-different model.
+eps maps from hf_config.rms_norm_eps (Llama-2 ships 1e-5);
+rope_scaling types "llama3" (Llama-3.1+) and "linear" convert with
+matching frequency scaling (ops/norms.py rope_frequencies).
+Checkpoints carrying tensors with no slot here (o_proj biases,
+yarn/dynamic rope variants) fail the conversion loudly instead of
+converting into a numerically different model.
 """
 
 from __future__ import annotations
@@ -42,14 +44,33 @@ def config_from_hf(hf_config) -> LlamaConfig:
     import jax.numpy as jnp
 
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
-        None, "default",
-    ):
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not implemented; converting "
-            "anyway would mis-position every token (Llama-3.1+ "
-            "frequency scaling)"
-        )
+    rope_scaling = None
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind in (None, "default"):
+            pass
+        elif kind == "llama3":
+            # Llama-3.1+ piecewise frequency scaling; numerics match
+            # HF modeling_rope_utils._compute_llama3_parameters
+            # (tests/test_hf_parity.py asserts logit parity).
+            rope_scaling = (
+                "llama3",
+                float(scaling["factor"]),
+                float(scaling.get("low_freq_factor", 1.0)),
+                float(scaling.get("high_freq_factor", 4.0)),
+                int(scaling["original_max_position_embeddings"]),
+            )
+        elif kind == "linear":
+            rope_scaling = (
+                "linear", float(scaling["factor"]), 1.0, 4.0, 0
+            )
+        else:
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} is not implemented "
+                "(yarn/dynamic/longrope need their own numerics "
+                "audit); converting anyway would mis-position every "
+                "token"
+            )
     model_type = getattr(hf_config, "model_type", "llama")
     if model_type not in ("llama", "qwen2"):
         raise NotImplementedError(
@@ -88,6 +109,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
         ),
         intermediate=hf_config.intermediate_size,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=rope_scaling,
         max_seq_len=getattr(
             hf_config, "max_position_embeddings", 4096
         ),
@@ -98,7 +120,10 @@ def config_from_hf(hf_config) -> LlamaConfig:
 
 
 def _np(tensor) -> np.ndarray:
-    return np.asarray(tensor.detach().cpu().numpy(), dtype=np.float32)
+    # .float() first: torch bf16 tensors don't expose .numpy().
+    return np.asarray(
+        tensor.detach().cpu().float().numpy(), dtype=np.float32
+    )
 
 
 def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):

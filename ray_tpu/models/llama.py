@@ -63,6 +63,13 @@ class LlamaConfig:
     norm_eps: float = 1e-6
     #: Attention QKV projection biases (Qwen2-family; Llama has none).
     attn_bias: bool = False
+    #: RoPE frequency scaling: None, or the tuple
+    #: (kind, factor, low_freq_factor, high_freq_factor, original_max)
+    #: — kind "linear" (position interpolation) or "llama3"
+    #: (Llama-3.1 piecewise; see ops/norms.py rope_frequencies).
+    #: A tuple (not a dict) so the frozen config stays hashable for
+    #: jit static args.
+    rope_scaling: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -297,7 +304,9 @@ def forward_and_aux(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t), (b, t))
     x = params["embed"][tokens].astype(cfg.dtype)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     def body(x, layer):
         return _layer(cfg, x, layer, cos, sin, sp_axis, ep_axis)

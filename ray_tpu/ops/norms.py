@@ -20,15 +20,54 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (normed * weight.astype(jnp.float32)).astype(dtype)
 
 
-def rotary_embedding(
-    positions: jax.Array, head_dim: int, theta: float = 10000.0
-):
-    """Rotary position embedding tables: returns (cos, sin) of shape
-    [*positions.shape, head_dim // 2], f32."""
+def rope_frequencies(
+    head_dim: int, theta: float = 10000.0, scaling=None
+) -> jax.Array:
+    """Per-dimension RoPE inverse frequencies, optionally rescaled.
+
+    `scaling` is None or a tuple
+    `(kind, factor, low_freq_factor, high_freq_factor, original_max)`:
+
+    - "linear": every frequency divided by `factor` (position
+      interpolation).
+    - "llama3": Llama-3.1's piecewise scheme (public formula; HF
+      modeling_rope_utils._compute_llama3_parameters): wavelengths
+      shorter than original_max/high_freq_factor keep their frequency,
+      longer than original_max/low_freq_factor divide by `factor`, and
+      the band between interpolates smoothly.
+    """
     half = head_dim // 2
     freqs = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
+    if scaling is None:
+        return freqs
+    kind, factor, low_ff, high_ff, orig_max = scaling
+    if kind == "linear":
+        return freqs / factor
+    if kind == "llama3":
+        low_wavelen = orig_max / low_ff
+        high_wavelen = orig_max / high_ff
+        wavelen = 2.0 * jnp.pi / freqs
+        smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+        smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+        return jnp.where(
+            wavelen > low_wavelen,
+            freqs / factor,
+            jnp.where(wavelen < high_wavelen, freqs, smoothed),
+        )
+    raise ValueError(f"unknown rope scaling kind {kind!r}")
+
+
+def rotary_embedding(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling=None,
+):
+    """Rotary position embedding tables: returns (cos, sin) of shape
+    [*positions.shape, head_dim // 2], f32."""
+    freqs = rope_frequencies(head_dim, theta, scaling)
     angles = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(angles), jnp.sin(angles)
 

@@ -98,9 +98,16 @@ class FaultTolerantActorManager:
                 )
                 if mark_unhealthy_on_failure:
                     self._healthy[idx] = False
+        # ONE deadline across the whole fan-out: sequential per-ref
+        # timeouts would compound (3 hung actors = 3x the budget); the
+        # reference manager bounds the pass at `timeout` total.
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
         for idx, ref in refs.items():
+            remaining = max(0.05, deadline - _time.monotonic())
             try:
-                value = rt.get(ref, timeout=timeout)
+                value = rt.get(ref, timeout=remaining)
                 results.append(
                     CallResult(actor_id=idx, ok=True, value=value)
                 )

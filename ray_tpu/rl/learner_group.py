@@ -179,8 +179,13 @@ class LearnerGroup:
             ],
             timeout=300,
         )
-        per_learner_mb = self.minibatch_size // world
-        steps = shard_n // per_learner_mb
+        # A batch smaller than one full minibatch must still train
+        # (the single-learner path runs its start==0 ragged minibatch;
+        # steps==0 here would silently skip the update forever).
+        per_learner_mb = max(
+            1, min(self.minibatch_size // world, shard_n)
+        )
+        steps = max(1, shard_n // per_learner_mb)
         metrics: Dict[str, float] = {}
         for epoch in range(self.num_epochs):
             rt.get(

@@ -3,12 +3,14 @@
 from .api import (
     delete,
     get_app_handle,
+    local_grpc_port,
     run,
     shutdown,
     proxy_ports,
     start,
     status,
 )
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import (
     Application,
     AutoscalingConfig,
@@ -21,6 +23,9 @@ from .router import DeploymentHandle, DeploymentResponse
 
 __all__ = [
     "deployment",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "local_grpc_port",
     "Deployment",
     "Application",
     "AutoscalingConfig",

@@ -129,6 +129,7 @@ def start(
     http_port: int = 8000,
     per_node: bool = True,
     http_host: str = "127.0.0.1",
+    grpc_port: Optional[int] = None,
 ) -> int:
     """Start HTTP proxies — one per alive node, each pinned with node
     affinity and routing to LOCAL replicas first (reference:
@@ -169,11 +170,24 @@ def start(
                 http_port,
                 node_id != local_node,  # extras may take ephemeral
                 http_host,
+                grpc_port,
             )
         port = rt.get(proxy.ready.remote(), timeout=60)
         if node_id == local_node:
             local_port = port
     return local_port if local_port is not None else http_port
+
+
+def local_grpc_port() -> Optional[int]:
+    """Bound gRPC ingress port of this node's proxy (None when
+    serve.start ran without grpc_port)."""
+    rt = _rt()
+    node_id = rt.get_runtime_context().get_node_id()
+    try:
+        proxy = rt.get_actor(_proxy_name(node_id), namespace=_NAMESPACE)
+        return rt.get(proxy.grpc_ready.remote(), timeout=30)
+    except Exception:
+        return None
 
 
 def proxy_ports() -> Dict[str, int]:

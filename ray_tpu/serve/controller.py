@@ -131,6 +131,8 @@ class ServeController:
             spec["init_args"],
             spec["init_kwargs"],
             replica_id,
+            app_name=app,
+            deployment_name=spec["name"],
         )
         # Block until the replica's constructor ran (readiness probe);
         # it reports its node so routers can prefer local replicas.
@@ -225,9 +227,34 @@ class ServeController:
                     "id": r["id"],
                     "actor": r["actor"],
                     "node_id": r.get("node_id"),
+                    # Multiplexed model ids loaded on this replica;
+                    # routers prefer warm holders (reference:
+                    # multiplexed_replicas ranking in the replica
+                    # scheduler).
+                    "model_ids": list(r.get("model_ids", ())),
                 }
                 for r in self._replicas.get((app, deployment), [])
             ]
+
+    def record_multiplexed(
+        self,
+        app: str,
+        deployment: str,
+        replica_id: str,
+        model_ids: List[str],
+    ) -> bool:
+        """A replica's multiplex LRU changed; push the new holder set
+        to routers over the replicas long-poll key (reference:
+        replicas push model ids via controller long-poll)."""
+        with self._lock:
+            for r in self._replicas.get((app, deployment), []):
+                if r["id"] == replica_id:
+                    r["model_ids"] = list(model_ids)
+                    break
+            else:
+                return False
+        self._bump(f"replicas:{app}/{deployment}")
+        return True
 
     def get_deployment_spec(self, app: str, deployment: str) -> dict:
         with self._lock:

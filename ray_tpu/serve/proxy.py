@@ -60,6 +60,7 @@ class Proxy:
         port: int,
         fallback_ephemeral: bool = True,
         host: str = "127.0.0.1",
+        grpc_port: int = None,
     ):
         self.port = port
         self._routes: Dict[str, Tuple[str, str]] = {}
@@ -115,6 +116,53 @@ class Proxy:
             name="serve-proxy-longpoll",
         )
         self._listener.start()
+        # Optional gRPC ingress on the same proxy (reference:
+        # proxy.py:431 gRPCProxy lives beside the HTTP proxy); routes
+        # by `application` call metadata.
+        self._grpc = None
+        self.grpc_port = None
+        if grpc_port is not None:
+            from .grpc_ingress import GrpcIngress
+
+            try:
+                self._grpc = GrpcIngress(
+                    grpc_port, self._grpc_handle_for,
+                    self._grpc_app_names, host=host,
+                )
+            except OSError:
+                if not fallback_ephemeral:
+                    raise
+                self._grpc = GrpcIngress(
+                    0, self._grpc_handle_for,
+                    self._grpc_app_names, host=host,
+                )
+            self.grpc_port = self._grpc.port
+
+    # -- gRPC routing --------------------------------------------------
+    def _grpc_handle_for(self, app: str):
+        from .router import DeploymentHandle
+
+        self._refresh_routes()
+        targets = {
+            a: (a, ingress)
+            for _prefix, (a, ingress) in self._routes.items()
+        }
+        if app not in targets:
+            self._refresh_routes(force=True)
+            targets = {
+                a: (a, ingress)
+                for _prefix, (a, ingress) in self._routes.items()
+            }
+        key = targets.get(app)
+        if key is None:
+            return None
+        if key not in self._handles:
+            self._handles[key] = DeploymentHandle(*key)
+        return self._handles[key]
+
+    def _grpc_app_names(self) -> list:
+        self._refresh_routes(force=True)
+        return sorted({a for (a, _d) in self._routes.values()})
 
     # -- routing -------------------------------------------------------
     def _refresh_routes(self, force: bool = False) -> None:
@@ -200,14 +248,23 @@ class Proxy:
         )
         handle = self._handles[key]
         handle._refresh()
+        # Reference header: requests carry the model they need and the
+        # router prefers replicas already holding it (multiplex.py).
+        model_id = handler.headers.get(
+            "serve_multiplexed_model_id", ""
+        )
         with handle._lock:
             streaming = bool(
                 (handle._state["spec"] or {}).get("ingress_streaming")
             )
         if streaming:
-            chunks = handle.options(stream=True).remote(request)
+            chunks = handle.options(
+                stream=True, multiplexed_model_id=model_id
+            ).remote(request)
             self._stream_response(handler, chunks)
             return None
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         value = handle.remote(request).result(timeout=60)
         if isinstance(value, bytes):
             return 200, value, "application/octet-stream"
@@ -271,6 +328,11 @@ class Proxy:
     def ready(self) -> int:
         return self.port
 
+    def grpc_ready(self):
+        return self.grpc_port
+
     def stop(self) -> bool:
+        if self._grpc is not None:
+            self._grpc.stop()
         self._server.shutdown()
         return True

@@ -29,6 +29,8 @@ class Replica:
         init_args: tuple,
         init_kwargs: dict,
         replica_id: str,
+        app_name: str = "",
+        deployment_name: str = "",
     ):
         from .router import DeploymentHandle
 
@@ -42,6 +44,32 @@ class Replica:
         args = tuple(materialize(a) for a in init_args)
         kwargs = {k: materialize(v) for k, v in init_kwargs.items()}
         self._instance = cls(*args, **kwargs)
+        # Multiplex LRU changes report this replica's loaded model set
+        # to the controller, which long-poll-pushes it to routers
+        # (multiplex.py reads this hook when it lazily builds the
+        # wrapper on the first get_model call — after __init__, so
+        # installing it here is early enough).
+        if app_name and deployment_name:
+            def _report_models(model_ids, _self=self):
+                try:
+                    from .api import _get_or_create_controller
+
+                    controller = _get_or_create_controller()
+                    controller.record_multiplexed.remote(
+                        app_name,
+                        deployment_name,
+                        replica_id,
+                        list(model_ids),
+                    )
+                except Exception:
+                    pass
+
+            try:
+                self._instance.__serve_multiplex_report__ = (
+                    _report_models
+                )
+            except Exception:
+                pass  # __slots__ classes: no router warmth hints
         self.replica_id = replica_id
         self._served = 0
         # Replicas run with max_concurrency > 1 (controller wires
@@ -52,7 +80,11 @@ class Replica:
         self._served_lock = threading.Lock()
         self._started = time.time()
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
+    def handle_request(
+        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+    ):
+        from .multiplex import _set_request_model_id
+
         with self._served_lock:
             self._served += 1
         target = (
@@ -60,18 +92,24 @@ class Replica:
             if method == "__call__"
             else getattr(self._instance, method)
         )
-        if method == "__call__":
+        token = _set_request_model_id(model_id)
+        try:
             return target(*args, **kwargs)
-        return target(*args, **kwargs)
+        finally:
+            from .multiplex import _model_id_ctx
+
+            _model_id_ctx.reset(token)
 
     def handle_request_streaming(
-        self, method: str, args: tuple, kwargs: dict
+        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
     ):
         """Generator variant: the user method must yield chunks; each
         yield ships to the caller immediately over the runtime's
         streaming-generator transport (reference: replica.py
         handle_request_streaming + StreamingObjectRefGenerator).
         Called with num_returns='streaming' by the router."""
+        from .multiplex import _model_id_ctx, _set_request_model_id
+
         with self._served_lock:
             self._served += 1
         target = (
@@ -79,7 +117,11 @@ class Replica:
             if method == "__call__"
             else getattr(self._instance, method)
         )
-        yield from target(*args, **kwargs)
+        token = _set_request_model_id(model_id)
+        try:
+            yield from target(*args, **kwargs)
+        finally:
+            _model_id_ctx.reset(token)
 
     def node_id(self) -> str:
         """This replica's node (routers prefer local replicas)."""

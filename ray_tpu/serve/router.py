@@ -220,6 +220,7 @@ class DeploymentHandle:
         # handle family.
         self._listener_box: Dict[str, Any] = {"thread": None}
         self._stream = False
+        self._model_id = ""  # multiplexed model id for this clone
 
     # -- routing -------------------------------------------------------
     def _refresh(self, force: bool = False) -> None:
@@ -312,6 +313,17 @@ class DeploymentHandle:
                 )
             time.sleep(0.05)
             self._refresh(force=True)
+        # Model warmth beats locality: a replica already holding the
+        # request's multiplexed model skips a load (reference: the
+        # replica scheduler ranks multiplexed-model holders first).
+        if self._model_id:
+            warm = [
+                r
+                for r in replicas
+                if self._model_id in (r.get("model_ids") or ())
+            ]
+            if warm:
+                replicas = warm
         # Locality: prefer replicas on this node when any exist
         # (reference: pow_2 replica scheduler's locality-preferred
         # candidate set); pow-2 needs >=2 candidates to choose among.
@@ -407,18 +419,29 @@ class DeploymentHandle:
         )
         self._share_state_with(clone)
         clone._method = name
+        clone._model_id = self._model_id
         return clone
 
-    def options(self, *, stream: bool = False) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        stream: bool = False,
+        multiplexed_model_id: str = "",
+    ) -> "DeploymentHandle":
         """`stream=True` makes remote() return a
         DeploymentResponseGenerator whose chunks arrive as the replica
         yields them (reference: handle.py
-        DeploymentHandle.options(stream=True))."""
+        DeploymentHandle.options(stream=True)).
+        `multiplexed_model_id` tags requests with the model they need;
+        the router prefers replicas already holding it and the replica
+        exposes it via serve.get_multiplexed_model_id() (reference:
+        handle.options(multiplexed_model_id=...))."""
         clone = DeploymentHandle(
             self.app_name, self.deployment_name, self._method
         )
         self._share_state_with(clone)
         clone._stream = stream
+        clone._model_id = multiplexed_model_id or self._model_id
         return clone
 
     def remote(self, *args, **kwargs):
@@ -444,13 +467,13 @@ class DeploymentHandle:
         if self._stream:
             ref_gen = replica["actor"].handle_request_streaming.options(
                 num_returns="streaming"
-            ).remote(self._method, args, kwargs)
+            ).remote(self._method, args, kwargs, self._model_id)
             self._ongoing_sent(replica["id"])
             return DeploymentResponseGenerator(
                 ref_gen, self, replica["id"]
             )
         ref = replica["actor"].handle_request.remote(
-            self._method, args, kwargs
+            self._method, args, kwargs, self._model_id
         )
         self._ongoing_sent(replica["id"])
 

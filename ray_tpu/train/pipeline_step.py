@@ -137,6 +137,7 @@ def make_pp_train_step(
         cos, sin = rotary_embedding(
             jnp.broadcast_to(positions, (mb, t_loc)),
             cfg.head_dim, cfg.rope_theta,
+            getattr(cfg, "rope_scaling", None),
         )
 
         # Embedding runs on every pp rank (cheap vs the stack); only

@@ -435,3 +435,29 @@ def test_runtime_context_async_actor():
         assert first == after_await
         ids.add(first)
     assert len(ids) == 4
+
+
+def test_duplicate_actor_name_surfaces_error():
+    """Creates are pipelined one-way notifies, so a name collision
+    can't ride the create's RPC reply — it must still surface as a
+    detectable failure on the duplicate handle's method calls
+    (reference: ray raises on duplicate named actors; here the dead
+    handle errors instead of hanging)."""
+
+    @rt.remote
+    class Named:
+        def ping(self):
+            return "first"
+
+    first = Named.options(name="dup-name").remote()
+    assert rt.get(first.ping.remote(), timeout=60) == "first"
+
+    second = Named.options(name="dup-name").remote()
+    with pytest.raises(Exception) as exc_info:
+        rt.get(second.ping.remote(), timeout=30)
+    assert "dead" in str(exc_info.value).lower() or "registration" in str(
+        exc_info.value
+    ).lower()
+
+    # The original actor is untouched by the failed duplicate.
+    assert rt.get(first.ping.remote(), timeout=60) == "first"
