@@ -52,9 +52,16 @@ class Replica:
         if app_name and deployment_name:
             def _report_models(model_ids, _self=self):
                 try:
-                    from .api import _get_or_create_controller
+                    import ray_tpu as rt
 
-                    controller = _get_or_create_controller()
+                    from .controller import CONTROLLER_NAME
+
+                    # get_actor directly: replicas run inside worker
+                    # processes where api._rt()'s driver-style init
+                    # path doesn't apply.
+                    controller = rt.get_actor(
+                        CONTROLLER_NAME, namespace="serve"
+                    )
                     controller.record_multiplexed.remote(
                         app_name,
                         deployment_name,
