@@ -240,6 +240,11 @@ class NodeDaemon:
         self._spawn_queue: "queue.Queue" = queue.Queue()
         self._spawn_thread: Optional[threading.Thread] = None
         self._spawn_failures = 0
+        #: Cumulative (never reset): workers that died before
+        #: registering. Test fixtures assert this stays 0 — a startup
+        #: crash is a bug even when a later spawn succeeded
+        #: (the consecutive counter above resets on success).
+        self._spawn_crash_total = 0
         self._shutdown = False
         self._worker_procs: List[subprocess.Popen] = []
         # Direct-transport leases: lease_id -> (worker_conn_id,
@@ -3600,6 +3605,7 @@ class NodeDaemon:
                 with self._lock:
                     self._spawning = max(0, self._spawning - 1)
                     self._spawn_failures += 1
+                    self._spawn_crash_total += 1
                 self._schedule()
 
     def _spawn_worker_blocking(self, needs_tpu: bool) -> None:
@@ -3682,6 +3688,7 @@ class NodeDaemon:
                         with self._lock:
                             self._spawning = max(0, self._spawning - 1)
                             self._spawn_failures += 1
+                            self._spawn_crash_total += 1
                             failures = self._spawn_failures
                         if failures >= 3:
                             self._fail_all_queued(

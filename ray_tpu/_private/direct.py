@@ -46,6 +46,7 @@ worker death.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -592,15 +593,48 @@ class DirectTaskManager:
                 self._drop_lease(lease, release=False)
 
 
+_router_pool = None
+_router_pool_lock = threading.Lock()
+
+
+def _router_executor():
+    """Shared pool draining actor routers (reference role:
+    actor_task_submitter's client callbacks). One THREAD per actor
+    handle collapses at the 10k-actor scale; per-actor ordering
+    survives because each router drains its own queue with at most one
+    pool task at a time."""
+    global _router_pool
+    with _router_pool_lock:
+        if _router_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _router_pool = ThreadPoolExecutor(
+                max_workers=int(
+                    os.environ.get("RT_DIRECT_ROUTER_THREADS", "64")
+                ),
+                thread_name_prefix="rt-actor-router",
+            )
+        return _router_pool
+
+
+def _reset_router_pool_after_fork() -> None:
+    global _router_pool
+    _router_pool = None
+
+
+os.register_at_fork(after_in_child=_reset_router_pool_after_fork)
+
+
 class ActorDirectRouter:
     """Per-actor direct call router.
 
-    A single thread per actor handle preserves submission order across
-    transport decisions: it resolves the actor's direct address
-    (blocking until the actor is ALIVE), then drains the call queue
-    over a dedicated connection. Remote-node actors and unrecoverable
-    connection failures fall back to the daemon path — sticky, so
-    ordering never interleaves between transports."""
+    An ORDERED per-actor queue drained by at most one shared-pool task
+    at a time preserves submission order across transport decisions:
+    the drain resolves the actor's direct address (blocking until the
+    actor is ALIVE), then pushes calls over a dedicated connection.
+    Remote-node actors and unrecoverable connection failures fall back
+    to the daemon path — sticky, so ordering never interleaves
+    between transports."""
 
     def __init__(self, core, actor_id):
         self._core = core
@@ -610,26 +644,27 @@ class ActorDirectRouter:
         self._mode = "resolving"  # resolving | direct | daemon | dead
         self._client: Optional[RpcClient] = None
         self._shutdown = False
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"rt-actor-router-{actor_id.hex()[:8]}",
-        )
-        self._thread.start()
+        self._draining = False
 
     def submit(self, spec: dict, fut: ResultFuture) -> None:
         with self._cond:
             self._queue.append((spec, fut))
-            self._cond.notify()
+            if self._draining or self._shutdown:
+                return
+            self._draining = True
+        _router_executor().submit(self._drain)
 
-    def _run(self) -> None:
-        while not self._shutdown:
+    def _drain(self) -> None:
+        while True:
             with self._cond:
-                while not self._queue and not self._shutdown:
-                    self._cond.wait()
-                if self._shutdown:
+                if not self._queue or self._shutdown:
+                    self._draining = False
                     return
                 spec, fut = self._queue.pop(0)
-            self._dispatch(spec, fut)
+            try:
+                self._dispatch(spec, fut)
+            except Exception:
+                pass
 
     def _dispatch(self, spec: dict, fut: ResultFuture) -> None:
         if self._mode == "daemon":
@@ -665,9 +700,14 @@ class ActorDirectRouter:
             self._mode = "resolving"
             if spec.get("max_retries", 0) > 0:
                 spec["max_retries"] -= 1
+                rearm = False
                 with self._cond:
                     self._queue.insert(0, (spec, fut))
-                    self._cond.notify()
+                    if not self._draining and not self._shutdown:
+                        self._draining = True
+                        rearm = True
+                if rearm:
+                    _router_executor().submit(self._drain)
             else:
                 fut.fulfill(None, make_error_payload(
                     "ActorDiedError",
@@ -731,6 +771,4 @@ class ActorDirectRouter:
 
     def shutdown(self) -> None:
         self._shutdown = True
-        with self._cond:
-            self._cond.notify_all()
         self._teardown_client()

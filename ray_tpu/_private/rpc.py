@@ -56,6 +56,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from .wire import (
@@ -224,9 +225,17 @@ def send_msg(sock: socket.socket, msg: dict, key: bytes) -> None:
 
 
 def _sendall_vectored(sock: socket.socket, buffers: list) -> None:
+    import select as _select
+
     views = [memoryview(b).cast("B") for b in buffers if len(b)]
     while views:
-        sent = sock.sendmsg(views)
+        try:
+            sent = sock.sendmsg(views)
+        except (BlockingIOError, InterruptedError):
+            # Hub-registered sockets are non-blocking; senders run on
+            # ordinary threads and may wait for writability.
+            _select.select([], [sock], [], 5.0)
+            continue
         while sent > 0 and views:
             head = views[0]
             if sent >= len(head):
@@ -276,6 +285,257 @@ def _recv_exact(sock: socket.socket, n: int):
             return None
         got += r
     return buf
+
+
+# ---------------------------------------------------------------------------
+# selector hub: many sockets, one reader thread
+# ---------------------------------------------------------------------------
+
+class SelectorHub:
+    """One epoll/kqueue thread multiplexing every registered RPC
+    socket (reference: the asio event loop under every reference
+    server, src/ray/common/asio/ — a thread per connection collapses
+    at the 10k-actor scale: ~20k parked reader threads in the head +
+    driver processes turn the scheduler into the bottleneck long
+    before the protocol does).
+
+    Frames are assembled incrementally per socket; complete frames go
+    to the socket's `on_frame` callback ON THE HUB THREAD — callbacks
+    must not block (both the server and client layers immediately
+    hand off to executors / queues). EOF or socket error fires
+    `on_close` once and unregisters."""
+
+    def __init__(self, name: str = "rpc-hub"):
+        import selectors
+
+        self._selector = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        self._selector.register(
+            self._wake_r, selectors.EVENT_READ, None
+        )
+        self._lock = threading.Lock()
+        self._pending_ops: List[tuple] = []
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def register(self, sock, key, mac, on_frame, on_close) -> None:
+        sock.setblocking(False)
+        state = _SockState(sock, key, mac, on_frame, on_close)
+        with self._lock:
+            self._pending_ops.append(("add", sock, state))
+        self._wake()
+
+    def unregister(self, sock) -> None:
+        with self._lock:
+            self._pending_ops.append(("del", sock, None))
+        self._wake()
+
+    def _wake(self) -> None:
+        try:
+            os.write(self._wake_w, b"x")
+        except OSError:
+            pass
+
+    def _apply_ops(self) -> None:
+        import selectors
+
+        with self._lock:
+            ops, self._pending_ops = self._pending_ops, []
+        for op, sock, state in ops:
+            try:
+                if op == "add":
+                    self._selector.register(
+                        sock, selectors.EVENT_READ, state
+                    )
+                else:
+                    self._selector.unregister(sock)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _loop(self) -> None:
+        while not self._closed:
+            self._apply_ops()
+            try:
+                events = self._selector.select(timeout=1.0)
+            except OSError:
+                # A registered socket was closed by its owner without
+                # unregistering: the selector raises EBADF on every
+                # select. Sweep out dead fds (and fire their on_close)
+                # or this loop would spin forever serving nobody.
+                self._sweep_dead()
+                continue
+            for sel_key, _ in events:
+                if sel_key.fd == self._wake_r:
+                    try:
+                        while os.read(self._wake_r, 4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                state: _SockState = sel_key.data
+                if state is not None:
+                    self._service(state)
+
+    def _sweep_dead(self) -> None:
+        for sel_key in list(self._selector.get_map().values()):
+            sock = sel_key.fileobj
+            if sock == self._wake_r:
+                continue
+            dead = False
+            try:
+                dead = sock.fileno() < 0
+            except Exception:
+                dead = True
+            if dead:
+                try:
+                    self._selector.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    pass
+                state = sel_key.data
+                if state is not None and not state.closed:
+                    state.closed = True
+                    try:
+                        state.on_close()
+                    except Exception:
+                        pass
+
+    def _service(self, state: "_SockState") -> None:
+        closed = False
+        try:
+            while True:
+                chunk = state.sock.recv(1 << 20)
+                if not chunk:
+                    closed = True
+                    break
+                state.buf += chunk
+                # Over-greedy reads starve other sockets; parse what
+                # we have and come back on the next readiness event.
+                if len(state.buf) >= (16 << 20):
+                    break
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            closed = True
+        self._drain_frames(state)
+        if closed:
+            try:
+                self._selector.unregister(state.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            if not state.closed:
+                state.closed = True
+                try:
+                    state.on_close()
+                except Exception:
+                    pass
+
+    def _drain_frames(self, state: "_SockState") -> None:
+        header_len = _LEN.size + _DIGEST_BYTES
+        while True:
+            buf = state.buf
+            if len(buf) < header_len:
+                return
+            (length,) = _LEN.unpack_from(buf, 0)
+            if length > _MAX_FRAME:
+                state.buf = bytearray()
+                try:
+                    state.sock.close()  # poisoned peer: drop it
+                except OSError:
+                    pass
+                return
+            total = header_len + length
+            if len(buf) < total:
+                return
+            digest = bytes(buf[_LEN.size:header_len])
+            payload = bytes(buf[header_len:total])
+            state.buf = buf[total:]
+            if state.mac:
+                expect = _hmac.new(
+                    state.key, payload, hashlib.sha256
+                ).digest()
+                if not _hmac.compare_digest(digest, expect):
+                    continue  # unauthenticated frame: drop
+            try:
+                msg = decode_frame(payload)
+            except Exception:
+                continue
+            if msg is None:
+                continue
+            try:
+                state.on_frame(msg)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake()
+
+
+class _SockState:
+    __slots__ = ("sock", "key", "mac", "on_frame", "on_close", "buf",
+                 "closed")
+
+    def __init__(self, sock, key, mac, on_frame, on_close):
+        self.sock = sock
+        self.key = key
+        self.mac = mac
+        self.on_frame = on_frame
+        self.on_close = on_close
+        self.buf = bytearray()
+        self.closed = False
+
+
+_hub_lock = threading.Lock()
+_process_hub: Optional[SelectorHub] = None
+_client_pool = None
+
+
+def _reset_rpc_globals_after_fork() -> None:
+    """Forked children inherit the hub/pool OBJECTS but not their
+    threads; reset so the child lazily builds fresh ones."""
+    global _process_hub, _client_pool
+    _process_hub = None
+    _client_pool = None
+
+
+os.register_at_fork(after_in_child=_reset_rpc_globals_after_fork)
+
+
+def _client_executor():
+    """Shared pool draining client-side pushes/async callbacks (they
+    may block; the hub thread must not)."""
+    global _client_pool
+    with _hub_lock:
+        if _client_pool is None or getattr(
+            _client_pool, "_broken_by_fork", False
+        ):
+            from concurrent.futures import ThreadPoolExecutor
+
+            _client_pool = ThreadPoolExecutor(
+                max_workers=int(
+                    os.environ.get("RT_RPC_CLIENT_POOL_THREADS", "8")
+                ),
+                thread_name_prefix="rpc-client-pool",
+            )
+        return _client_pool
+
+
+def process_hub() -> SelectorHub:
+    """Process-wide hub shared by every RpcClient and RpcServer in
+    this process (daemons, drivers, and workers alike)."""
+    global _process_hub
+    with _hub_lock:
+        if _process_hub is None or _process_hub._closed:
+            _process_hub = SelectorHub()
+        # Forked children inherit the parent's hub OBJECT but not its
+        # thread: detect and rebuild (worker fork-server children).
+        if not _process_hub._thread.is_alive():
+            _process_hub = SelectorHub()
+        return _process_hub
 
 
 # ---------------------------------------------------------------------------
@@ -376,9 +636,23 @@ class RpcServer:
                 self._conn_counter += 1
                 conn = Connection(self, sock, self._conn_counter)
                 self._connections[conn.conn_id] = conn
-            threading.Thread(
-                target=conn.serve, name=f"rpc-conn-{conn.conn_id}", daemon=True
-            ).start()
+            # Handshake + hub registration; no thread per connection
+            # (SelectorHub reads all of them, handlers run on the
+            # server's bounded pool with per-connection ordering).
+            conn.start()
+
+    def _get_executor(self):
+        with self._lock:
+            if getattr(self, "_executor", None) is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._executor = ThreadPoolExecutor(
+                    max_workers=int(
+                        os.environ.get("RT_RPC_POOL_THREADS", "32")
+                    ),
+                    thread_name_prefix="rpc-pool",
+                )
+            return self._executor
 
     def _dispatch(self, conn: "Connection", msg: dict) -> None:
         method = msg.get("_method", "")
@@ -470,17 +744,28 @@ DEFERRED = object()
 
 
 class Connection:
-    """Server-side view of one client connection."""
+    """Server-side view of one client connection.
+
+    Frames arrive via the process SelectorHub; handlers run on the
+    server's bounded pool with PER-CONNECTION ordering (one drain task
+    at a time walks this connection's queue) — the property the
+    protocol relies on (e.g. a create_actor notify is processed before
+    the same driver's first method submit)."""
+
+    _DISCONNECT = object()
 
     def __init__(self, server: RpcServer, sock: socket.socket, conn_id: int):
         self._server = server
         self._sock = sock
         self.conn_id = conn_id
         self._send_lock = threading.Lock()
-        self._key = server.auth_key  # replaced by the conn key in serve
+        self._key = server.auth_key  # replaced by the conn key in start
         self.metadata: Dict[str, Any] = {}  # e.g. worker id after register
+        self._queue: deque = deque()
+        self._queue_lock = threading.Lock()
+        self._draining = False
 
-    def serve(self) -> None:
+    def start(self) -> None:
         # Nonce handshake: frames on this connection are keyed by
         # HMAC(cluster_key, nonce), so a frame recorded on another
         # connection can't be replayed here. The trailing byte carries
@@ -495,12 +780,45 @@ class Connection:
             self._server._on_disconnect(self)
             return
         self._key = _connection_key(self._server.auth_key, nonce)
+        process_hub().register(
+            self._sock,
+            self._key,
+            _frame_mac(self._sock),
+            self._on_frame,
+            self._on_close,
+        )
+
+    # -- hub callbacks (hub thread: enqueue only, never block) --------
+    def _on_frame(self, msg: dict) -> None:
+        self._enqueue(msg)
+
+    def _on_close(self) -> None:
+        # Rides the same ordered queue so the disconnect handler runs
+        # AFTER every frame that arrived before EOF.
+        self._enqueue(self._DISCONNECT)
+
+    def _enqueue(self, item) -> None:
+        with self._queue_lock:
+            self._queue.append(item)
+            if self._draining:
+                return
+            self._draining = True
+        self._server._get_executor().submit(self._drain)
+
+    def _drain(self) -> None:
         while True:
-            msg = recv_msg(self._sock, self._key)
-            if msg is None:
-                break
-            self._server._dispatch(self, msg)
-        self._server._on_disconnect(self)
+            with self._queue_lock:
+                if not self._queue:
+                    self._draining = False
+                    return
+                item = self._queue.popleft()
+            if item is self._DISCONNECT:
+                self._server._on_disconnect(self)
+                continue
+            try:
+                self._server._dispatch(self, item)
+            except Exception:
+                pass
 
     def reply(self, mid, payload: dict) -> None:
         payload = dict(payload)
@@ -522,6 +840,10 @@ class Connection:
                 pass
 
     def close(self) -> None:
+        try:
+            process_hub().unregister(self._sock)
+        except Exception:
+            pass
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -591,13 +913,85 @@ class RpcClient:
         self._on_reconnect = cb
 
     def _start_reader(self, sock, key, gen) -> None:
-        self._reader = threading.Thread(
-            target=self._read_loop,
-            args=(sock, key, gen),
-            name=f"rpc-client:{self._path}",
-            daemon=True,
+        """Register with the process SelectorHub (one epoll thread for
+        every client in the process — a thread per client collapses at
+        the 10k-direct-connection scale). Sync replies resolve inline
+        on the hub thread (event.set, non-blocking); pushes and async
+        callbacks drain through a per-client ORDERED queue on the
+        shared client pool, preserving the old single-reader-thread
+        ordering for a connection's pushes."""
+        process_hub().register(
+            sock,
+            key,
+            _frame_mac(sock),
+            lambda msg: self._hub_frame(msg, gen),
+            lambda: self._hub_closed(gen),
         )
-        self._reader.start()
+
+    def _hub_frame(self, msg: dict, gen: int) -> None:
+        mid = msg.get("_mid")
+        if mid == -1:
+            if self._push_handler is not None:
+                self._enqueue_work(("push", msg))
+            return
+        with self._lock:
+            event = self._pending.pop(mid, None)
+            if event is not None:
+                self._replies[mid] = msg
+            callback = self._pending_cb.pop(mid, None)
+            if callback is not None:
+                self._pending_gen.pop(mid, None)
+        if event is not None:
+            event.set()
+        if callback is not None:
+            self._enqueue_work(("cb", callback, msg))
+
+    def _hub_closed(self, gen: int) -> None:
+        # Connection lost: wake all waiters with an error — but only
+        # if this registration still owns the live connection; a stale
+        # socket's teardown must not fail calls issued on its
+        # replacement.
+        with self._lock:
+            if gen != self._conn_gen:
+                return
+            for mid, event in self._pending.items():
+                self._replies[mid] = {"_error": "__connection_lost__"}
+                event.set()
+            self._pending.clear()
+            self._pending_gen.clear()
+            callbacks = list(self._pending_cb.values())
+            self._pending_cb.clear()
+        for callback in callbacks:
+            self._enqueue_work(
+                ("cb", callback, {"_error": "__connection_lost__"})
+            )
+
+    def _enqueue_work(self, item) -> None:
+        with self._lock:
+            queue = getattr(self, "_work_queue", None)
+            if queue is None:
+                queue = self._work_queue = deque()
+                self._work_draining = False
+            queue.append(item)
+            if self._work_draining:
+                return
+            self._work_draining = True
+        _client_executor().submit(self._drain_work)
+
+    def _drain_work(self) -> None:
+        while True:
+            with self._lock:
+                if not self._work_queue:
+                    self._work_draining = False
+                    return
+                item = self._work_queue.popleft()
+            try:
+                if item[0] == "push":
+                    self._push_handler(item[1].get("_push", ""), item[1])
+                else:
+                    item[1](item[2])
+            except Exception:
+                pass
 
     def _connect(self, timeout: float) -> Tuple[socket.socket, bytes]:
         deadline = time.time() + timeout
@@ -660,52 +1054,6 @@ class RpcClient:
                 sock.close()
                 time.sleep(0.05)
         raise ConnectionLost(f"cannot connect to {self._path}: {last_err}")
-
-    def _read_loop(self, sock, key, gen) -> None:
-        while not self._closed:
-            msg = recv_msg(sock, key)
-            if msg is None:
-                break
-            mid = msg.get("_mid")
-            if mid == -1:
-                if self._push_handler is not None:
-                    try:
-                        self._push_handler(msg.get("_push", ""), msg)
-                    except Exception:
-                        pass
-                continue
-            with self._lock:
-                event = self._pending.pop(mid, None)
-                if event is not None:
-                    self._replies[mid] = msg
-                callback = self._pending_cb.pop(mid, None)
-                if callback is not None:
-                    self._pending_gen.pop(mid, None)
-            if event is not None:
-                event.set()
-            if callback is not None:
-                try:
-                    callback(msg)
-                except Exception:
-                    pass
-        # Connection lost: wake all waiters with an error — but only if
-        # this reader still owns the live connection; a stale reader
-        # must not fail calls issued on its replacement.
-        with self._lock:
-            if gen != self._conn_gen:
-                return
-            for mid, event in self._pending.items():
-                self._replies[mid] = {"_error": "__connection_lost__"}
-                event.set()
-            self._pending.clear()
-            self._pending_gen.clear()
-            callbacks = list(self._pending_cb.values())
-            self._pending_cb.clear()
-        for callback in callbacks:
-            try:
-                callback({"_error": "__connection_lost__"})
-            except Exception:
-                pass
 
     def call(
         self,
@@ -887,6 +1235,10 @@ class RpcClient:
 
     def close(self) -> None:
         self._closed = True
+        try:
+            process_hub().unregister(self._sock)
+        except Exception:
+            pass
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

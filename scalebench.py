@@ -27,6 +27,14 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CASE_TIMEOUT = float(os.environ.get("RT_SCALEBENCH_TIMEOUT", "570"))
+#: Heavyweight cases get their own budget: 10k dedicated worker
+#: processes on a 1-core box spawn at ~25-30/s once the box is under
+#: its own load — a legitimate ~7-minute case, not a wedge.
+CASE_TIMEOUT_OVERRIDES = {
+    "actors_10k_16_daemons": float(
+        os.environ.get("RT_SCALEBENCH_TIMEOUT_10K", "900")
+    ),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -223,8 +231,133 @@ def case_broadcast_256mb_8_daemons() -> dict:
         cluster.shutdown()
 
 
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return round(int(line.split()[1]) / 1024, 1)
+    return 0.0
+
+
+def case_tasks_1m_queue_one_daemon() -> dict:
+    """1M nop tasks SUBMITTED AND QUEUED through one daemon
+    (reference envelope: '1,000,000+ tasks queued on one node',
+    release/benchmarks/README.md:32). Completion streams concurrently;
+    the case asserts the head survives the full queue depth without
+    OOM (RSS recorded) and that completions flow while the backlog is
+    at full depth (first-wave sample get)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8)
+    try:
+        @rt.remote
+        def nop():
+            return None
+
+        rt.get(nop.remote(), timeout=60)
+        base_rss = _rss_mb()
+        n = 1_000_000
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(n)]
+        submit_s = time.perf_counter() - t0
+        peak_rss = _rss_mb()
+        # Liveness under full backlog: the first submitted wave must
+        # complete while ~1M tasks are still queued behind it.
+        rt.get(refs[:1000], timeout=120)
+        alive_s = time.perf_counter() - t0
+        return {
+            "n": n,
+            "submit_seconds": round(submit_s, 1),
+            "submit_rate": round(n / submit_s, 1),
+            "first_1k_done_at_s": round(alive_s, 1),
+            "rss_mb_before": base_rss,
+            "rss_mb_at_full_queue": peak_rss,
+            "seconds": round(submit_s, 1),
+            "unit": "tasks submitted+queued/s",
+        }
+    finally:
+        rt.shutdown()
+
+
+def case_actors_10k_16_daemons() -> dict:
+    """10k zero-resource actors across 16 daemons, each on a dedicated
+    forked worker, each pinged once (reference envelope: '10,000+
+    actors', release/benchmarks/README.md:13)."""
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 1.0})
+    try:
+        for _ in range(15):
+            cluster.add_node(num_cpus=1.0)
+        cluster.wait_for_nodes(16, timeout=120)
+        rt.init(address=cluster.address)
+
+        @rt.remote(num_cpus=0)
+        class Slot:
+            def ping(self):
+                return os.getpid()
+
+        n = 10_000
+        t0 = time.perf_counter()
+        actors = [
+            Slot.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(n)
+        ]
+        submit_s = time.perf_counter() - t0
+        pids = rt.get(
+            [a.ping.remote() for a in actors],
+            timeout=CASE_TIMEOUT_OVERRIDES["actors_10k_16_daemons"] - 60,
+        )
+        dt = time.perf_counter() - t0
+        distinct = len(set(pids))
+        assert distinct == n, f"expected {n} dedicated workers: {distinct}"
+        return {
+            "n": n,
+            "nodes": 16,
+            "submit_seconds": round(submit_s, 1),
+            "seconds": round(dt, 1),
+            "rate": round(n / dt, 1),
+            "rss_mb_head_process": _rss_mb(),
+            "unit": "actors/s",
+        }
+    finally:
+        rt.shutdown()
+        cluster.shutdown()
+
+
+def case_args_10k_one_task() -> dict:
+    """One task taking 10,000 ObjectRef args (reference envelope:
+    '10,000 args', release/benchmarks/README.md:27)."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=4)
+    try:
+        @rt.remote
+        def many_args(*args):
+            return len(args)
+
+        refs = [rt.put(i) for i in range(10_000)]
+        t0 = time.perf_counter()
+        assert (
+            rt.get(many_args.remote(*refs), timeout=CASE_TIMEOUT - 60)
+            == 10_000
+        )
+        dt = time.perf_counter() - t0
+        return {
+            "n_args": 10_000,
+            "seconds": round(dt, 2),
+            "unit": "seconds for one 10k-arg task",
+        }
+    finally:
+        rt.shutdown()
+
+
 CASES = {
     "tasks_100k_one_daemon": case_tasks_100k_one_daemon,
+    "tasks_1m_queue_one_daemon": case_tasks_1m_queue_one_daemon,
+    "actors_10k_16_daemons": case_actors_10k_16_daemons,
+    "args_10k_one_task": case_args_10k_one_task,
     "get_10k_objects": case_get_10k_objects,
     "args_and_returns_1k": case_args_and_returns_1k,
     "actors_1k_16_daemons": case_actors_1k_16_daemons,
@@ -237,6 +370,7 @@ CASES = {
 # ---------------------------------------------------------------------------
 
 def _run_case_subprocess(name: str) -> dict:
+    case_timeout = CASE_TIMEOUT_OVERRIDES.get(name, CASE_TIMEOUT)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # runtime-bound: keep off the chip
     env["PALLAS_AXON_POOL_IPS"] = ""
@@ -250,12 +384,12 @@ def _run_case_subprocess(name: str) -> dict:
              "--case", name],
             capture_output=True,
             text=True,
-            timeout=CASE_TIMEOUT,
+            timeout=case_timeout,
             env=env,
             cwd=REPO,
         )
     except subprocess.TimeoutExpired:
-        return {"ok": False, "error": f"timeout after {CASE_TIMEOUT}s"}
+        return {"ok": False, "error": f"timeout after {case_timeout}s"}
     if proc.returncode != 0:
         return {
             "ok": False,
