@@ -224,9 +224,20 @@ def send_msg(sock: socket.socket, msg: dict, key: bytes) -> None:
         raise ConnectionLost(str(e)) from e
 
 
-def _sendall_vectored(sock: socket.socket, buffers: list) -> None:
-    import select as _select
+def _wait_writable(sock: socket.socket, timeout: float) -> None:
+    # NOT select.select: it raises ValueError for fds >= FD_SETSIZE
+    # (1024) — exactly the many-connection regime the hub enables.
+    import selectors as _selectors
 
+    sel = _selectors.DefaultSelector()
+    try:
+        sel.register(sock, _selectors.EVENT_WRITE)
+        sel.select(timeout)
+    finally:
+        sel.close()
+
+
+def _sendall_vectored(sock: socket.socket, buffers: list) -> None:
     views = [memoryview(b).cast("B") for b in buffers if len(b)]
     while views:
         try:
@@ -234,7 +245,7 @@ def _sendall_vectored(sock: socket.socket, buffers: list) -> None:
         except (BlockingIOError, InterruptedError):
             # Hub-registered sockets are non-blocking; senders run on
             # ordinary threads and may wait for writability.
-            _select.select([], [sock], [], 5.0)
+            _wait_writable(sock, 5.0)
             continue
         while sent > 0 and views:
             head = views[0]
@@ -348,9 +359,24 @@ class SelectorHub:
         for op, sock, state in ops:
             try:
                 if op == "add":
-                    self._selector.register(
-                        sock, selectors.EVENT_READ, state
-                    )
+                    try:
+                        self._selector.register(
+                            sock, selectors.EVENT_READ, state
+                        )
+                    except KeyError:
+                        # fd reuse: a closed socket's entry still maps
+                        # this fd (the owner closed without
+                        # unregistering; epoll dropped it silently).
+                        # Evict the stale entry or the NEW connection
+                        # would be permanently deaf.
+                        stale = self._selector.get_map().get(
+                            sock.fileno()
+                        )
+                        if stale is not None:
+                            self._selector.unregister(stale.fileobj)
+                        self._selector.register(
+                            sock, selectors.EVENT_READ, state
+                        )
                 else:
                     self._selector.unregister(sock)
             except (KeyError, ValueError, OSError):
@@ -433,42 +459,72 @@ class SelectorHub:
                 except Exception:
                     pass
 
-    def _drain_frames(self, state: "_SockState") -> None:
-        header_len = _LEN.size + _DIGEST_BYTES
-        while True:
-            buf = state.buf
-            if len(buf) < header_len:
-                return
-            (length,) = _LEN.unpack_from(buf, 0)
-            if length > _MAX_FRAME:
-                state.buf = bytearray()
-                try:
-                    state.sock.close()  # poisoned peer: drop it
-                except OSError:
-                    pass
-                return
-            total = header_len + length
-            if len(buf) < total:
-                return
-            digest = bytes(buf[_LEN.size:header_len])
-            payload = bytes(buf[header_len:total])
-            state.buf = buf[total:]
-            if state.mac:
-                expect = _hmac.new(
-                    state.key, payload, hashlib.sha256
-                ).digest()
-                if not _hmac.compare_digest(digest, expect):
-                    continue  # unauthenticated frame: drop
+    def _kill(self, state: "_SockState") -> None:
+        state.buf = bytearray()
+        try:
+            self._selector.unregister(state.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            state.sock.close()
+        except OSError:
+            pass
+        if not state.closed:
+            state.closed = True
             try:
-                msg = decode_frame(payload)
-            except Exception:
-                continue
-            if msg is None:
-                continue
-            try:
-                state.on_frame(msg)
+                state.on_close()
             except Exception:
                 pass
+
+    def _drain_frames(self, state: "_SockState") -> None:
+        header_len = _LEN.size + _DIGEST_BYTES
+        buf = state.buf
+        offset = 0  # consume via offset; reslicing per frame is O(n^2)
+        try:
+            while True:
+                if len(buf) - offset < header_len:
+                    return
+                (length,) = _LEN.unpack_from(buf, offset)
+                if length > _MAX_FRAME:
+                    offset = 0
+                    self._kill(state)  # poisoned peer: drop it
+                    return
+                total = header_len + length
+                if len(buf) - offset < total:
+                    return
+                digest = bytes(
+                    buf[offset + _LEN.size:offset + header_len]
+                )
+                payload = bytes(
+                    buf[offset + header_len:offset + total]
+                )
+                offset += total
+                if state.mac:
+                    expect = _hmac.new(
+                        state.key, payload, hashlib.sha256
+                    ).digest()
+                    if not _hmac.compare_digest(digest, expect):
+                        # Unauthenticated frame: terminate the
+                        # connection (module-docstring invariant;
+                        # matches recv_msg).
+                        offset = 0
+                        self._kill(state)
+                        return
+                try:
+                    msg = decode_frame(payload)
+                except Exception:
+                    offset = 0
+                    self._kill(state)
+                    return
+                if msg is None:
+                    continue
+                try:
+                    state.on_frame(msg)
+                except Exception:
+                    pass
+        finally:
+            if offset and state.buf is buf:
+                del buf[:offset]  # single compaction per drain
 
     def close(self) -> None:
         self._closed = True
@@ -510,9 +566,7 @@ def _client_executor():
     may block; the hub thread must not)."""
     global _client_pool
     with _hub_lock:
-        if _client_pool is None or getattr(
-            _client_pool, "_broken_by_fork", False
-        ):
+        if _client_pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
             _client_pool = ThreadPoolExecutor(
@@ -1176,6 +1230,14 @@ class RpcClient:
                     return
                 if seen_gen is not None and self._conn_gen != seen_gen:
                     return  # somebody else already reconnected
+            # Unregister BEFORE close: epoll forgets a closed fd
+            # silently, but the selectors bookkeeping would keep the
+            # stale entry and make the replacement socket (which
+            # typically reuses the same fd) fail to register.
+            try:
+                process_hub().unregister(self._sock)
+            except Exception:
+                pass
             try:
                 self._sock.close()
             except OSError:
@@ -1237,6 +1299,14 @@ class RpcClient:
         self._closed = True
         try:
             process_hub().unregister(self._sock)
+        except Exception:
+            pass
+        # Unregistering suppresses the hub's on_close, so flush
+        # blocked call(timeout=None) waiters here — the removed
+        # per-client reader thread used to do this when its recv
+        # failed.
+        try:
+            self._hub_closed(self._conn_gen)
         except Exception:
             pass
         try:
