@@ -3690,7 +3690,15 @@ class NodeDaemon:
                             self._spawn_failures += 1
                             self._spawn_crash_total += 1
                             failures = self._spawn_failures
-                        if failures >= 3:
+                        # Consecutive-failure trip wire. Generous by
+                        # default: under heavy load a few slow spawns
+                        # die racing their connect timeout while the
+                        # SYSTEM is healthy, and nuking the queue for
+                        # that turns overload into an outage.
+                        limit = int(
+                            os.environ.get("RT_SPAWN_FAILURE_LIMIT", "10")
+                        )
+                        if failures >= limit:
                             self._fail_all_queued(
                                 "worker processes are crashing at "
                                 "startup; see "

@@ -145,7 +145,20 @@ class CoreWorker:
             self._direct_server.register("execute_task", _h_direct_execute)
             self._direct_server.register("ping", lambda conn, msg: {})
             self._direct_server.start()
-        self._client = RpcClient(socket_path, push_handler=self._on_push)
+        # Workers give the daemon a LONG connect window: on an
+        # overloaded box (10k-actor waves) the daemon's accept thread
+        # can go unscheduled for tens of seconds, and a worker that
+        # gives up at the default 10s counts as a startup crash —
+        # three of those nuke the whole task queue.
+        self._client = RpcClient(
+            socket_path,
+            push_handler=self._on_push,
+            connect_timeout=float(
+                os.environ.get("RT_WORKER_CONNECT_TIMEOUT", "60")
+            )
+            if role == "worker"
+            else 10.0,
+        )
         reply = self._client.call(
             "register_client",
             role=role,
