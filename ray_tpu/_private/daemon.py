@@ -245,6 +245,13 @@ class NodeDaemon:
         #: crash is a bug even when a later spawn succeeded
         #: (the consecutive counter above resets on success).
         self._spawn_crash_total = 0
+        #: Every pid that has EVER registered as a worker. The spawn
+        #: watcher must consult history, not the live `workers` dict: a
+        #: short-lived worker (one fast trial, then exit) can register
+        #: AND exit between two watcher ticks — judging only by "is it
+        #: registered right now" counts that healthy lifecycle as a
+        #: startup crash (observed: TPE trials under heavy box load).
+        self._registered_pids_ever: set = set()
         self._shutdown = False
         self._worker_procs: List[subprocess.Popen] = []
         # Direct-transport leases: lease_id -> (worker_conn_id,
@@ -536,6 +543,7 @@ class NodeDaemon:
             )
             with self._lock:
                 self.workers[conn.conn_id] = info
+                self._registered_pids_ever.add(msg["pid"])
                 self._spawning = max(0, self._spawning - 1)
                 self._spawn_failures = 0
             conn.metadata["role"] = "worker"
@@ -775,6 +783,24 @@ class NodeDaemon:
             winfo = self.workers.pop(conn.conn_id, None)
             self.drivers.pop(conn.conn_id, None)
             dead_node = self._node_conns.pop(conn.conn_id, None)
+            if winfo is not None:
+                # Keep the registration-history set bounded: the spawn
+                # watcher usually consumes the pid within a tick, but a
+                # watch entry that expired before a slow registration
+                # would otherwise pin the pid forever.
+                self._registered_pids_ever.discard(winfo.pid)
+        if winfo is not None:
+            # A disconnecting worker provably registered — resolve any
+            # still-pending spawn watch for its pid HERE, not via the
+            # history set (which the line above just pruned): a
+            # starved watcher that only woke after this disconnect
+            # would otherwise see "exited, never registered" and count
+            # a healthy short-lived worker as a startup crash.
+            with self._spawn_watch_lock:
+                self._spawn_watchlist[:] = [
+                    e for e in self._spawn_watchlist
+                    if e[0].pid != winfo.pid
+                ]
         self._drop_log_subscriber(conn.conn_id)
         if dead_node is not None:
             self._on_node_death(dead_node)
@@ -3651,8 +3677,17 @@ class NodeDaemon:
         spawn, each scanning the workers dict on its own 0.2s tick,
         was O(spawns x workers) of pure poll overhead at the
         1000-actor scale."""
+        # The window must outlast the worker's own daemon-connect
+        # budget (RT_WORKER_CONNECT_TIMEOUT, 60s): a worker still in
+        # its connect retry loop is pending, not dead, and dropping it
+        # from the watchlist early would leak its startup slot.
+        window = 30.0 + float(
+            os.environ.get("RT_WORKER_CONNECT_TIMEOUT", "60")
+        )
+        # Mutable entry: the watch loop appends a grace deadline on
+        # first seeing the process exited.
         with self._spawn_watch_lock:
-            self._spawn_watchlist.append((proc, time.time() + 30))
+            self._spawn_watchlist.append([proc, time.time() + window])
             if self._spawn_watcher is None or not (
                 self._spawn_watcher.is_alive()
             ):
@@ -3674,16 +3709,40 @@ class NodeDaemon:
                 time.sleep(0.05)
                 continue
             with self._lock:
-                live_pids = {w.pid for w in self.workers.values()}
+                # History, not the live dict: a fast worker can
+                # register AND exit between ticks (short trial, idle
+                # reap) — that is a success, not a startup crash.
+                # Membership per watched pid (not a whole-set copy:
+                # the set is O(workers ever) on long-lived daemons),
+                # and CONSUMED on resolution so a later reuse of the
+                # same pid by a new spawn is judged on its own
+                # registration, not this one's.
+                registered = {
+                    e[0].pid
+                    for e in watched
+                    if e[0].pid in self._registered_pids_ever
+                }
+                self._registered_pids_ever -= registered
             now = time.time()
             done = []
-            for proc, deadline in watched:
-                if proc.pid in live_pids:
-                    done.append((proc, deadline))
+            for entry in watched:
+                proc, deadline = entry[0], entry[1]
+                if proc.pid in registered:
+                    done.append(entry)
                     continue
                 exited = proc.poll() is not None
+                if exited and len(entry) == 2:
+                    # First sighting of the exit. The registration RPC
+                    # may still be sitting unprocessed in the daemon's
+                    # socket buffer (the worker can exit while its
+                    # register_client is in flight under load), so give
+                    # it one grace window before judging.
+                    entry.append(now + 2.0)
+                    continue
+                if exited and now < entry[2]:
+                    continue  # grace window still open
                 if exited or now > deadline:
-                    done.append((proc, deadline))
+                    done.append(entry)
                     if exited:
                         with self._lock:
                             self._spawning = max(0, self._spawning - 1)

@@ -211,3 +211,50 @@ def test_fork_server_spawns_workers():
         assert ppid != _os.getpid()
     finally:
         rt.shutdown()
+
+
+def test_spawn_watcher_judgment():
+    """The spawn watcher must count a worker that dies before EVER
+    registering as a startup crash, but must NOT count a fast
+    register→work→exit lifecycle (short trial, idle reap) — judging by
+    the live workers dict alone miscounted healthy short-lived workers
+    whenever the watcher thread was starved past their whole lifetime
+    (observed: TPE trials under heavy box load)."""
+    rt.init(num_cpus=2)
+    try:
+        daemon = rt.api._session.daemon
+
+        class FakeProc:
+            def __init__(self, pid, rc):
+                self.pid = pid
+                self._rc = rc
+
+            def poll(self):
+                return self._rc
+
+        base = daemon._spawn_crash_total
+
+        # Registered-then-exited: pid is in the history set even
+        # though it is long gone from daemon.workers.
+        reg_pid = 2**22 - 101
+        with daemon._lock:
+            daemon._registered_pids_ever.add(reg_pid)
+        daemon._watch_worker_start(FakeProc(reg_pid, 0))
+
+        # Never-registered exit: a genuine startup crash.
+        daemon._watch_worker_start(FakeProc(2**22 - 103, 1))
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if daemon._spawn_crash_total > base:
+                break
+            time.sleep(0.1)
+        assert daemon._spawn_crash_total == base + 1, (
+            "exactly the unregistered exit must count as a crash"
+        )
+        # Counter hygiene for the session fixture's zero assertion.
+        daemon._spawn_crash_total = base
+        with daemon._lock:
+            daemon._registered_pids_ever.discard(reg_pid)
+    finally:
+        rt.shutdown()
