@@ -138,7 +138,7 @@ def run_train_bench(tpu: bool) -> dict:
     if tpu:
         backend = jax.default_backend()
         assert backend not in ("cpu", "gpu"), f"not a TPU backend: {backend}"
-        cfg = LlamaConfig.bench_410m(remat_policy="dots")
+        cfg = LlamaConfig.bench_410m(remat_policy="dots_flash")
         batch, seq = 8, 2048
         steps, warmup = 20, 3
     else:
@@ -229,7 +229,7 @@ def run_7b_layer_bench() -> dict:
         return LlamaConfig(
             vocab_size=32000, dim=4096, n_layers=n_layers, n_heads=32,
             n_kv_heads=32, intermediate=11008, max_seq_len=seq,
-            dtype=jnp.bfloat16, attention="flash", remat_policy="dots",
+            dtype=jnp.bfloat16, attention="flash", remat_policy="dots_flash",
         )
 
     mesh = MeshSpec(fsdp=len(jax.devices())).build()

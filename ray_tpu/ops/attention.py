@@ -5,7 +5,9 @@ torch); for the TPU build this kernel is the MFU-critical op
 (SURVEY.md §7 hard part 4). Design follows the standard TPU flash
 pattern: sequential grid over KV blocks with online-softmax state in
 VMEM scratch, f32 accumulation, causal block skipping, and a custom
-VJP whose backward is two Pallas kernels (dq and dk/dv passes).
+VJP whose backward is ONE fused Pallas kernel computing dq, dk and dv
+from a single s/p evaluation per tile (dq accumulates through an
+aliased HBM buffer; dk/dv in VMEM scratch).
 
 Layout: [batch, heads, seq, head_dim] with head_dim padded to 128
 (MXU lane width). GQA is handled above this op by repeating KV heads.
@@ -19,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 try:  # TPU-only module; import lazily so CPU tests work.
@@ -48,6 +51,39 @@ def _mask_logits(s, qi, ki, block_q, block_k, causal, kv_len):
     if causal:
         valid = jnp.logical_and(valid, rows >= cols)
     return jnp.where(valid, s, DEFAULT_MASK_VALUE)
+
+
+def _bias_fast_path(causal, block_q, block_k, kv_len, q_len) -> bool:
+    """True when diagonal-block masking can use ONE precomputed
+    additive bias tile held in VMEM scratch for the kernel's whole
+    lifetime. Requires square blocks (every run&masked tile is then an
+    exact diagonal with identical relative pattern: qi*bq == ki*bk ⇒
+    local rows >= local cols) and no KV/Q padding. The per-tile iota/
+    compare/select masking otherwise costs ~6 VPU passes over
+    [block_q, block_k] — on a kernel whose MXU work is only two
+    d=128-deep matmuls per tile, the VPU, not the MXU, is the
+    bottleneck, and one f32 add against a resident tile is the
+    cheapest mask that exists."""
+    return (
+        causal
+        and block_q == block_k
+        and kv_len % block_k == 0
+        and q_len % block_q == 0
+    )
+
+
+def _init_bias_tile(bias_ref, first_step) -> None:
+    """Fill the additive causal-mask tile (0 below/on the diagonal,
+    -1e38 above) once, at the first grid step; scratch persists across
+    the sequential TPU grid so every later diagonal tile reuses it."""
+
+    @pl.when(first_step)
+    def _():
+        rows = jax.lax.broadcasted_iota(jnp.int32, bias_ref.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, bias_ref.shape, 1)
+        bias_ref[:] = jnp.where(
+            rows >= cols, 0.0, DEFAULT_MASK_VALUE
+        ).astype(bias_ref.dtype)
 
 
 def _block_needs_mask(qi, ki, block_q, block_k, causal, kv_len):
@@ -99,13 +135,28 @@ def mha_reference(
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, out_ref, lse_ref,
-    acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
-    kv_len: int,
+    acc_ref, m_ref, l_ref, bias_ref,
+    *, causal: bool, block_q: int, block_k: int,
+    kv_len: int, fast_mask: bool,
 ):
+    """Online-softmax flash forward in the log2 domain.
+
+    q arrives PRE-SCALED by scale*log2(e) (see _flash_forward), so the
+    raw QK^T dot already holds log2-domain logits: no per-tile scale
+    multiply, and exp() becomes the cheaper exp2(). The VPU — not the
+    MXU — limits this kernel at head_dim 128 (two d=128 matmuls per
+    [bq, bk] tile vs ~4 elementwise passes over it), so every saved
+    full-tile pass is ~10% of kernel time. lse is emitted in the SAME
+    log2 domain; the backward kernels consume it symmetrically."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+
+    if fast_mask:
+        _init_bias_tile(
+            bias_ref,
+            (pl.program_id(0) == 0) & (qi == 0) & (ki == 0),
+        )
 
     @pl.when(ki == 0)
     def _init():
@@ -122,8 +173,8 @@ def _fwd_kernel(
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [bq, bk] f32
-        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        p = jnp.exp2(s - m_new)  # [bq, bk] f32
+        alpha = jnp.exp2(m_prev - m_new)  # [bq, 1]
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -143,13 +194,23 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk] f32
+        )  # [bq, bk] f32, log2-domain logits
 
         needs_mask = _block_needs_mask(
             qi, ki, block_q, block_k, causal, kv_len
         )
         if needs_mask is None:
             _update(s, v)
+        elif fast_mask:
+            # Square blocks: the only run&masked tiles are exact
+            # diagonals — one resident additive tile masks them all.
+            @pl.when(needs_mask)
+            def _masked():
+                _update(s + bias_ref[:], v)
+
+            @pl.when(jnp.logical_not(needs_mask))
+            def _interior():
+                _update(s, v)
         else:
             @pl.when(needs_mask)
             def _masked():
@@ -170,9 +231,14 @@ def _fwd_kernel(
         l_safe = jnp.where(l == 0.0, 1.0, l)
         out_ref[0] = (acc_ref[:] / l_safe).astype(out_ref.dtype)
         # lse rides in an 8-sublane layout (TPU block shapes need the
-        # second-to-last dim divisible by 8).
-        row = m_ref[:, 0] + jnp.log(l_safe[:, 0])  # [bq]
+        # second-to-last dim divisible by 8). Log2 domain, like m.
+        row = m_ref[:, 0] + jnp.log2(l_safe[:, 0])  # [bq]
         lse_ref[0] = jnp.broadcast_to(row[None, :], lse_ref.shape[1:])
+
+
+#: Pre-scaling constant: folding softmax scale AND log2(e) into q turns
+#: the per-tile `s * scale` pass + natural exp into a bare dot + exp2.
+_LOG2E = math.log2(math.e)
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len):
@@ -181,14 +247,21 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len):
     nq = pl.cdiv(t, block_q)
     nk = pl.cdiv(tk, block_k)
     grid = (bh, nq, nk)
+    fast_mask = _bias_fast_path(causal, block_q, block_k, kv_len, t)
     kernel = functools.partial(
         _fwd_kernel,
-        scale=scale,
         causal=causal,
         block_q=block_q,
         block_k=block_k,
         kv_len=kv_len,
+        fast_mask=fast_mask,
     )
+    # XLA fuses this multiply into q's producer; inside the kernel it
+    # would cost a pass per (qi, ki) tile instead of one per qi block.
+    # f32 multiply then cast: the effective logit scale stays exact
+    # (only the usual bf16 storage rounding), where a bf16*bf16
+    # multiply would perturb the softmax temperature itself.
+    q2 = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -209,9 +282,16 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len):
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
+            # bf16: halves the tile's scoped-VMEM footprint — the f32
+            # version pushed the 1024x1024 fwd config 292K past the
+            # 16M scoped limit. 0 and -1e38 are both exact in bf16.
+            pltpu.VMEM(
+                (block_q, block_k) if fast_mask else (8, 128),
+                jnp.bfloat16,
+            ),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(q2, k, v)
     return out, lse
 
 
@@ -219,85 +299,58 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, kv_len):
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    acc_ref,
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_in_ref,
+    dq_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref, bias_ref, dq_all_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
-    kv_len: int,
+    kv_len: int, q_len: int, fast_mask: bool, interp: bool,
 ):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    """Single-pass backward: dq, dk, dv from ONE s/p computation per
+    tile. Split dq + dkv kernels would each recompute s = q2 @ k^T and
+    p = exp2(s - lse) — 2 of 7 MXU passes and ~40% of the VPU work
+    duplicated. The grid is kv-major (dk/dv accumulate in VMEM
+    scratch); dq instead accumulates through an ALIASED HBM buffer
+    (dq_in -> dq, f32): its (b, qi) block is revisited
+    non-consecutively across ki, so each visit adds this tile's
+    contribution. Tiles skipped by the causal test copy the partial
+    sum through (the output block is emitted every step regardless).
 
-    @pl.when(ki == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    run = True
-    if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_k
-
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][0][:, None]  # [bq, 1]
-        delta = delta_ref[0][0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-
-        def _update(s):
-            p = jnp.exp(s - lse)
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = (p * (dp - delta) * scale).astype(k.dtype)
-            acc_ref[:] += jax.lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-
-        needs_mask = _block_needs_mask(
-            qi, ki, block_q, block_k, causal, kv_len
-        )
-        if needs_mask is None:
-            _update(s)
-        else:
-            @pl.when(needs_mask)
-            def _masked():
-                _update(_mask_logits(
-                    s, qi, ki, block_q, block_k, causal, kv_len
-                ))
-
-            @pl.when(jnp.logical_not(needs_mask))
-            def _interior():
-                _update(s)
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc_ref, dv_acc_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
-    kv_len: int, q_len: int,
-):
+    Chain-rule factor placement (q2 = scale * log2e * q, lse in the
+    log2 domain, so p = exp2(s - lse) equals the natural-domain
+    softmax exactly):
+      dv = p^T @ do                      — exact as accumulated;
+      ds = p * (dp - delta)              — natural-domain ds/scale;
+      dq = sum_k (ds @ k) * scale        — scale per [bq, d] tile;
+      dk = (sum_q ds^T @ q2) * ln2       — ln2 * log2e == 1 restores
+                                           scale * ds^T @ q at the
+                                           final store."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
+    nk = pl.num_programs(1)
+
+    if fast_mask:
+        _init_bias_tile(
+            bias_ref,
+            (pl.program_id(0) == 0) & (ki == 0) & (qi == 0),
+        )
 
     @pl.when(qi == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
+    if interp:
+        # Interpreter mode does not preserve written output blocks
+        # across non-consecutive revisits (the aliased-HBM dq
+        # accumulation below reads back stale input instead), so CPU
+        # validation accumulates dq in a full-size scratch — fine at
+        # test shapes, unaffordable at real sequence lengths.
+        @pl.when((ki == 0) & (qi == 0))
+        def _init_dq_all():
+            dq_all_ref[:] = jnp.zeros_like(dq_all_ref)
+
     run = True
     if causal:
         run = qi * block_q + block_q - 1 >= ki * block_k
@@ -308,12 +361,12 @@ def _bwd_dkv_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][0][:, None]
+        lse = lse_ref[0][0][:, None]  # log2 domain
         delta = delta_ref[0][0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
 
         def _update(p):
             pb = p.astype(do.dtype)
@@ -325,11 +378,27 @@ def _bwd_dkv_kernel(
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+            ds = (p * (dp - delta)).astype(q.dtype)  # [bq, bk]
             dk_acc_ref[:] += jax.lax.dot_general(
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            # `scale` applied per TILE on the small [bq, d] result
+            # (ds itself omits it — see kernel docstring), so the
+            # running dq sum is always final-scaled: no last-tile
+            # bookkeeping, and the TPU and interpreter accumulation
+            # schemes stay numerically identical.
+            dq_tile = jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if interp:
+                sl = pl.dslice(qi * block_q, block_q)
+                dq_all_ref[sl, :] += dq_tile
+                dq_ref[0] = dq_all_ref[sl, :]
+            else:
+                prev = jnp.where(ki == 0, 0.0, dq_in_ref[0])
+                dq_ref[0] = prev + dq_tile
 
         def _row_masked(p):
             # Padded q rows (beyond q_len) must not contribute.
@@ -338,78 +407,87 @@ def _bwd_dkv_kernel(
             ) + qi * block_q
             return jnp.where(row_ids < q_len, p, 0.0)
 
-        nq_total = pl.num_programs(2)
         needs_mask = _block_needs_mask(
             qi, ki, block_q, block_k, causal, kv_len
         )
         q_may_pad = q_len % block_q != 0  # static
         if q_may_pad:
-            row_mask = qi == nq_total - 1
+            row_mask = qi == nq - 1
             needs_mask = (
                 row_mask if needs_mask is None else needs_mask | row_mask
             )
         if needs_mask is None:
-            _update(jnp.exp(s - lse))
+            _update(jnp.exp2(s - lse))
+        elif fast_mask:
+            @pl.when(needs_mask)
+            def _masked():
+                _update(jnp.exp2(s + bias_ref[:] - lse))
+
+            @pl.when(jnp.logical_not(needs_mask))
+            def _interior():
+                _update(jnp.exp2(s - lse))
         else:
             @pl.when(needs_mask)
             def _masked():
-                p = jnp.exp(_mask_logits(
+                p = jnp.exp2(_mask_logits(
                     s, qi, ki, block_q, block_k, causal, kv_len
                 ) - lse)
                 _update(_row_masked(p) if q_may_pad else p)
 
             @pl.when(jnp.logical_not(needs_mask))
             def _interior():
-                _update(jnp.exp(s - lse))
+                _update(jnp.exp2(s - lse))
+
+    @pl.when(jnp.logical_not(run))
+    def _passthrough():
+        # Skipped causal tiles still emit the dq block: carry the
+        # partial (already per-tile-scaled) sum forward unchanged.
+        if interp:
+            sl = pl.dslice(qi * block_q, block_q)
+            dq_ref[0] = dq_all_ref[sl, :]
+        else:
+            dq_ref[0] = dq_in_ref[0]
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc_ref[:] * math.log(2.0)).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k, kv_len, q_len):
+def _flash_backward_fused(
+    q, k, v, out, lse, do, scale, causal, block_q, block_k, kv_len, q_len
+):
     bh, t, d = q.shape
     tk = k.shape[1]
-    # The bwd kernels hold more f32 intermediates (s, p, dp, ds plus
-    # two accumulators) than the fwd; at block 1024x1024 with d=128
-    # they overflow the 16 MiB scoped-VMEM budget, so cap the q tile.
+    # f32 intermediates (s, p, dp, ds) plus three accumulators cap the
+    # square tile at 512 under the 16 MiB scoped-VMEM budget. Square,
+    # so the diagonal-bias fast path applies (_bias_fast_path).
     block_q = min(block_q, 512)
+    block_k = min(block_k, 512)
     nq = pl.cdiv(t, block_q)
     nk = pl.cdiv(tk, block_k)
+    fast_mask = _bias_fast_path(causal, block_q, block_k, kv_len, q_len)
+    q2 = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     delta = jnp.sum(
         out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
     )  # [bh, t]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t))
+    bias_scratch = pltpu.VMEM(
+        (block_q, block_k) if fast_mask else (8, 128), jnp.bfloat16
+    )
+    # dq accumulator rides in HBM through an aliased input/output pair
+    # (its blocks are revisited non-consecutively); never read at
+    # ki == 0, so uninitialized contents are fine.
+    dq_seed = jnp.empty((bh, t, d), jnp.float32)
 
-    dq = pl.pallas_call(
+    interp = _interpret()
+    dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel,
+            _bwd_fused_kernel,
             scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
-            kv_len=kv_len,
-        ),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel,
-            scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
-            kv_len=kv_len, q_len=q_len,
+            kv_len=kv_len, q_len=q_len, fast_mask=fast_mask,
+            interp=interp,
         ),
         grid=(bh, nk, nq),
         in_specs=[
@@ -419,22 +497,32 @@ def _flash_backward(q, k, v, out, lse, do, scale, causal, block_q, block_k, kv_l
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
             pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
+            bias_scratch,
+            # Full-size dq scratch only for interpreter-mode CPU
+            # validation (see _bwd_fused_kernel); token-size on TPU.
+            pltpu.VMEM(
+                (nq * block_q, d) if interp else (8, 128), jnp.float32
+            ),
         ],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+        input_output_aliases={6: 0},
+        interpret=interp,
+    )(q2, k, v, do, lse, delta, dq_seed)
+    return dq.astype(q.dtype), dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +550,14 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, kv_len, q_len):
     out, lse = _flash_forward(
         q, k, v, scale, causal, block_q, block_k, kv_len
     )
+    # Residuals carry checkpoint names so a remat policy that saves
+    # them (models.llama remat_policy="dots_flash") turns the backward
+    # recompute of this kernel into a table lookup: without the names,
+    # jax.checkpoint re-RUNS the whole forward flash kernel inside the
+    # backward pass just to rebuild (out, lse) — measured at ~15% of
+    # the 410M bench step (2.7ms/layer fwd kernel x 24 layers).
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -469,7 +565,7 @@ def _flash_bwd_rule(
     scale, causal, block_q, block_k, kv_len, q_len, residuals, do
 ):
     q, k, v, out, lse = residuals
-    dq, dk, dv = _flash_backward(
+    dq, dk, dv = _flash_backward_fused(
         q, k, v, out, lse, do, scale, causal, block_q, block_k,
         kv_len, q_len,
     )

@@ -78,6 +78,33 @@ class TestFlashAttention:
                 err_msg=f"d{name} mismatch",
             )
 
+    @pytest.mark.parametrize("blocks", [(128, 256), (256, 128)])
+    def test_gradients_unequal_blocks(self, blocks):
+        """Non-square tiles take the slow masking path and have no
+        exact-diagonal structure — the regime where any square-block
+        assumption in the fused backward (per-tile scale placement,
+        bias fast path gating) breaks (review r5 finding)."""
+        bq, bk = blocks
+        q, k, v = _qkv(jax.random.PRNGKey(7), h=1, t=256)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                force_pallas=True,
+            )
+            return jnp.sum(out * out)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name} mismatch (bq={bq}, bk={bk})",
+            )
+
     def test_bf16_inputs(self):
         q, k, v = _qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
         ref = mha_reference(
