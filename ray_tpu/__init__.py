@@ -25,7 +25,7 @@ from .api import (
     timeline,
     wait,
 )
-from .actor import ActorClass, ActorHandle
+from .actor import ActorClass, ActorHandle, method
 from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
 
@@ -36,6 +36,7 @@ __all__ = [
     "shutdown",
     "is_initialized",
     "remote",
+    "method",
     "get",
     "put",
     "wait",

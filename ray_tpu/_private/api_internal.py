@@ -153,10 +153,43 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
     worker = _require_worker()
     opts = ac.actor_options
     class_key = _export_cached(ac.underlying, ac, "_exported_key", worker)
+    # Named concurrency groups (reference: core_worker/transport/
+    # concurrency_group_manager.h): each group is an independent
+    # executor of the given width; methods without a group run in the
+    # default pool (width = max_concurrency).
+    concurrency_groups = opts.get("concurrency_groups") or {}
+    for gname, width in concurrency_groups.items():
+        if not isinstance(gname, str) or not gname:
+            raise ValueError(
+                f"concurrency group names must be non-empty strings: "
+                f"{gname!r}"
+            )
+        if not isinstance(width, int) or width < 1:
+            raise ValueError(
+                f"concurrency group {gname!r} needs a positive int "
+                f"width, got {width!r}"
+            )
+    # @rt.method definition-time defaults, resolved once here so every
+    # handle (including deserialized ones) sees them via the meta.
+    method_defaults = {}
+    for mname in ac.method_names():
+        fn = getattr(ac.underlying, mname, None)
+        mopts = getattr(fn, "__rt_method_options__", None)
+        if mopts:
+            group = mopts.get("concurrency_group")
+            if group is not None and group not in concurrency_groups:
+                raise ValueError(
+                    f"method {mname!r} names unknown concurrency "
+                    f"group {group!r} (declared: "
+                    f"{sorted(concurrency_groups)})"
+                )
+            method_defaults[mname] = dict(mopts)
     meta = {
         "class_name": ac.underlying.__name__,
         "methods": ac.method_names(),
         "class_key": class_key,
+        "concurrency_groups": concurrency_groups,
+        "method_defaults": method_defaults,
     }
     # Default actors require 1 CPU to *schedule* but hold 0 for their
     # lifetime (reference: ray_option_utils.py actor defaults —
@@ -186,6 +219,7 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         resources=resources,
         max_restarts=opts.get("max_restarts", 0),
         max_concurrency=int(opts.get("max_concurrency", 1)),
+        concurrency_groups=concurrency_groups,
         handle_meta=meta,
         scheduling_strategy=strategy,
         pg_context=pg_context,
@@ -203,13 +237,25 @@ def submit_actor_method(
     args: tuple,
     kwargs: dict,
     num_returns=1,
+    concurrency_group=None,
 ):
     worker = _require_worker()
     _validate_num_returns(num_returns)
+    if concurrency_group is not None:
+        declared = handle._meta.get("concurrency_groups")
+        # Meta from older handles may lack the key; validate when the
+        # declaration is known, else let the worker fall back to the
+        # default pool.
+        if declared is not None and concurrency_group not in declared:
+            raise ValueError(
+                f"unknown concurrency group {concurrency_group!r} "
+                f"(actor declares: {sorted(declared)})"
+            )
     refs = worker.submit_actor_task(
         handle.actor_id,
         method,
         _flatten_args(args, kwargs),
         num_returns=num_returns,
+        concurrency_group=concurrency_group,
     )
     return _generator_or_refs(refs, num_returns, worker)

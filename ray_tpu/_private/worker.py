@@ -120,6 +120,8 @@ class CoreWorker:
         self._actor_id: Optional[ActorID] = None
         self._actor_pg_context: Optional[dict] = None
         self._actor_pool = None  # ThreadPoolExecutor, max_concurrency>1
+        #: name -> ThreadPoolExecutor for named concurrency groups.
+        self._actor_group_pools: Dict[str, Any] = {}
         self._actor_loop = None  # asyncio loop thread for async methods
         self._actor_loop_lock = threading.Lock()
         self._running = True
@@ -801,6 +803,7 @@ class CoreWorker:
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         handle_meta: Optional[dict] = None,
         scheduling_strategy: Optional[dict] = None,
         pg_context: Optional[dict] = None,
@@ -834,6 +837,7 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": concurrency_groups or {},
             "handle_meta": handle_meta,
             "scheduling_strategy": scheduling_strategy,
             "pg_context": pg_context,
@@ -856,6 +860,7 @@ class CoreWorker:
         args: Sequence[Any],
         num_returns=1,
         max_retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = self._next_task_id()
         mode = num_returns if isinstance(num_returns, str) else None
@@ -877,6 +882,7 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "max_retries": max_retries,
             "num_returns_mode": mode,
+            "concurrency_group": concurrency_group,
         }
         if self._direct is not None:
             fut = self._direct.register(spec)
@@ -958,15 +964,23 @@ class CoreWorker:
             if item is None:
                 return
             spec, reply_to = item
-            if (
-                self._actor_pool is not None
-                and spec.get("kind") == "actor_task"
-            ):
+            pool = None
+            if spec.get("kind") == "actor_task":
                 # Concurrent actor: the loop thread only dispatches;
                 # up to max_concurrency method calls run on the pool
                 # (task context is thread-local, replies are
-                # send-locked, so pool threads are safe).
-                self._actor_pool.submit(self._execute, spec, reply_to)
+                # send-locked, so pool threads are safe). Named
+                # concurrency groups (reference: concurrency_group_
+                # manager.h) each own an independent pool, so a
+                # saturated group never stalls another; per-group FIFO
+                # order is the pool queue's.
+                group = spec.get("concurrency_group")
+                if group and self._actor_group_pools:
+                    pool = self._actor_group_pools.get(group)
+                if pool is None:
+                    pool = self._actor_pool
+            if pool is not None:
+                pool.submit(self._execute, spec, reply_to)
             else:
                 self._execute(spec, reply_to)
 
@@ -1081,13 +1095,19 @@ class CoreWorker:
                     self._actor_id = ActorID(spec["actor_id"])
                     self._actor_pg_context = spec.get("pg_context")
                     concurrency = int(spec.get("max_concurrency") or 1)
-                    if concurrency > 1:
+                    groups = spec.get("concurrency_groups") or {}
+                    if concurrency > 1 or groups:
                         # Concurrent actor (reference: concurrency_
                         # group_manager.h / threaded+async actors):
                         # method calls dispatch to a pool of N threads;
                         # coroutine-returning methods additionally run
                         # on a shared event loop so they can await each
                         # other while the pool bounds concurrency.
+                        # With named groups, the DEFAULT pool exists
+                        # even at width 1: default-group calls must
+                        # not run inline on the dispatch thread, or a
+                        # blocked default method would stall dispatch
+                        # into every other group.
                         import concurrent.futures
 
                         self._actor_pool = (
@@ -1096,6 +1116,13 @@ class CoreWorker:
                                 thread_name_prefix="rt-actor-exec",
                             )
                         )
+                        self._actor_group_pools = {
+                            gname: concurrent.futures.ThreadPoolExecutor(
+                                max_workers=int(width),
+                                thread_name_prefix=f"rt-actor-{gname}",
+                            )
+                            for gname, width in groups.items()
+                        }
                     results = [None]
                 elif kind == "actor_task":
                     if self._actor_instance is None:

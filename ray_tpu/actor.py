@@ -52,20 +52,69 @@ class ActorClass:
         ]
 
 
+def method(**options):
+    """Per-method default options, applied at class-definition time
+    (reference: python/ray/actor.py ray.method — num_returns and
+    concurrency_group annotations)::
+
+        @rt.remote(concurrency_groups={"io": 2})
+        class A:
+            @rt.method(concurrency_group="io")
+            def fetch(self): ...
+    """
+    allowed = {"num_returns", "concurrency_group"}
+    unknown = set(options) - allowed
+    if unknown:
+        raise ValueError(f"unknown method options: {sorted(unknown)}")
+
+    def decorator(fn):
+        fn.__rt_method_options__ = options
+        return fn
+
+    return decorator
+
+
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        name: str,
+        num_returns: int = 1,
+        concurrency_group: Optional[str] = None,
+    ):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(
+        self,
+        num_returns: Optional[int] = None,
+        concurrency_group: Optional[str] = None,
+        **_ignored,
+    ) -> "ActorMethod":
+        # None = keep this method's current value (which may carry an
+        # @rt.method definition-time default) — overriding one option
+        # must not silently reset the other.
+        return ActorMethod(
+            self._handle,
+            self._name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group
+            if concurrency_group is not None
+            else self._concurrency_group,
+        )
 
     def remote(self, *args, **kwargs):
         from ._private.api_internal import submit_actor_method
 
         return submit_actor_method(
-            self._handle, self._name, args, kwargs, self._num_returns
+            self._handle,
+            self._name,
+            args,
+            kwargs,
+            self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
 
     def bind(self, *args, **kwargs):
@@ -96,7 +145,13 @@ class ActorHandle:
                 f"Actor {self._meta.get('class_name', '?')} has no "
                 f"method {name!r}"
             )
-        return ActorMethod(self, name)
+        defaults = (self._meta.get("method_defaults") or {}).get(name, {})
+        return ActorMethod(
+            self,
+            name,
+            num_returns=defaults.get("num_returns", 1),
+            concurrency_group=defaults.get("concurrency_group"),
+        )
 
     def __repr__(self):
         return (

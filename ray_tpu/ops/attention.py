@@ -476,9 +476,14 @@ def _flash_backward_fused(
         (block_q, block_k) if fast_mask else (8, 128), jnp.bfloat16
     )
     # dq accumulator rides in HBM through an aliased input/output pair
-    # (its blocks are revisited non-consecutively); never read at
-    # ki == 0, so uninitialized contents are fine.
-    dq_seed = jnp.empty((bh, t, d), jnp.float32)
+    # (its blocks are revisited across ki). Never read at ki == 0;
+    # jnp.zeros still materializes a fill (JAX has no uninitialized
+    # arrays — ~64 MB/step at bench shapes, ~0.5% of step time), which
+    # the alias donates back to the output. Alias-revisit coherency
+    # (including the consecutive-revisit nq==1 case) is validated on
+    # hardware by the cross-attention grad shapes in the verify
+    # recipe — interpret mode cannot model it (see _bwd_fused_kernel).
+    dq_seed = jnp.zeros((bh, t, d), jnp.float32)
 
     interp = _interpret()
     dq, dk, dv = pl.pallas_call(

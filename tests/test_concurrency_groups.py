@@ -1,0 +1,139 @@
+"""Named concurrency groups (reference: core_worker/transport/
+concurrency_group_manager.h — each group is an independent executor of
+declared width; methods bind to groups at definition time via
+ray.method or per-call via .options)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu as rt
+
+
+def test_groups_isolate_blocked_group(rt_session):
+    """A call blocked in one group must not stall calls in another
+    group or the default pool — the deadlock below resolves ONLY if
+    `release` (default group) runs while `hold` (io group) is parked
+    in its own pool."""
+
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        def __init__(self):
+            self.event = threading.Event()
+
+        def hold(self):
+            # Parks the io group's only thread until release() runs.
+            assert self.event.wait(timeout=30)
+            return "held"
+
+        def release(self):
+            self.event.set()
+            return "released"
+
+    a = A.remote()
+    held = a.hold.options(concurrency_group="io").remote()
+    time.sleep(0.2)  # hold() is parked in the io pool
+    assert rt.get(a.release.remote(), timeout=30) == "released"
+    assert rt.get(held, timeout=30) == "held"
+
+
+def test_group_width_bounds_parallelism(rt_session):
+    """Group width caps in-flight calls in that group, and width > 1
+    genuinely overlaps them (both observed via an in-actor counter —
+    pool threads share the instance)."""
+
+    @rt.remote(concurrency_groups={"par": 2})
+    class A:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.active = 0
+            self.peak = 0
+
+        def work(self):
+            with self.lock:
+                self.active += 1
+                self.peak = max(self.peak, self.active)
+            time.sleep(0.3)
+            with self.lock:
+                self.active -= 1
+
+        def peak_seen(self):
+            return self.peak
+
+    a = A.remote()
+    rt.get(
+        [
+            a.work.options(concurrency_group="par").remote()
+            for _ in range(4)
+        ],
+        timeout=60,
+    )
+    peak = rt.get(a.peak_seen.remote(), timeout=30)
+    assert peak == 2, f"width-2 group should run exactly 2 at once: {peak}"
+
+
+def test_method_decorator_binds_group(rt_session):
+    """@rt.method(concurrency_group=...) routes calls without per-call
+    options; group pool threads are observable by name."""
+
+    @rt.remote(concurrency_groups={"io": 2})
+    class A:
+        @rt.method(concurrency_group="io")
+        def fetch(self):
+            return threading.current_thread().name
+
+        def plain(self):
+            return threading.current_thread().name
+
+    a = A.remote()
+    io_thread = rt.get(a.fetch.remote(), timeout=30)
+    plain_thread = rt.get(a.plain.remote(), timeout=30)
+    assert io_thread.startswith("rt-actor-io"), io_thread
+    assert not plain_thread.startswith("rt-actor-io"), plain_thread
+
+
+def test_unknown_group_rejected(rt_session):
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        def f(self):
+            return 1
+
+    a = A.remote()
+    with pytest.raises(ValueError, match="unknown concurrency group"):
+        a.f.options(concurrency_group="nope").remote()
+
+    with pytest.raises(ValueError, match="unknown concurrency group"):
+        @rt.remote(concurrency_groups={"io": 1})
+        class B:
+            @rt.method(concurrency_group="gpu")
+            def g(self):
+                return 2
+
+        B.remote()
+
+
+def test_group_declaration_validated(rt_session):
+    @rt.remote(concurrency_groups={"bad": 0})
+    class A:
+        def f(self):
+            return 1
+
+    with pytest.raises(ValueError, match="positive int"):
+        A.remote()
+
+
+def test_options_preserves_method_defaults(rt_session):
+    """options(concurrency_group=...) must not reset an
+    @rt.method(num_returns=...) definition-time default (review r5:
+    the asymmetric merge silently dropped it)."""
+
+    @rt.remote(concurrency_groups={"io": 1})
+    class A:
+        @rt.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    a = A.remote()
+    r1, r2 = a.pair.options(concurrency_group="io").remote()
+    assert rt.get([r1, r2], timeout=30) == [1, 2]
