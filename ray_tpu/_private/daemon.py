@@ -359,6 +359,7 @@ class NodeDaemon:
             "cluster_load",
             "metrics_record",
             "metrics_summary",
+            "event_stats",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -3854,6 +3855,15 @@ class NodeDaemon:
             summary["queued_tasks"] = self.scheduler.queued_count()
             summary["infeasible_tasks"] = len(self._infeasible)
         return {"summary": summary}
+
+    def _h_event_stats(self, conn, msg):
+        """Per-handler RPC timing stats for THIS daemon (reference:
+        event_stats.cc dump in the debug state). Unlike most read
+        APIs this does not forward to the head — the asker names the
+        node whose loop it is diagnosing by connecting to it."""
+        from .event_stats import stats
+
+        return {"handlers": stats().snapshot()}
 
     def _h_list_task_events(self, conn, msg):
         if not self.is_head:

@@ -59,6 +59,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
+from .event_stats import stats as _event_stats
 from .wire import (
     PROTOCOL_VERSION,
     ProtocolVersionError,
@@ -708,7 +709,9 @@ class RpcServer:
                 )
             return self._executor
 
-    def _dispatch(self, conn: "Connection", msg: dict) -> None:
+    def _dispatch(
+        self, conn: "Connection", msg: dict, t_enq: float = 0.0
+    ) -> None:
         method = msg.get("_method", "")
         mid = msg.get("_mid")
         handler = self._handlers.get(method)
@@ -716,6 +719,8 @@ class RpcServer:
             if mid:
                 conn.reply(mid, {"_error": f"no such method: {method}"})
             return
+        t_start = time.monotonic()
+        queue_s = (t_start - t_enq) if t_enq else 0.0
         # Typed argument validation (wire.SCHEMAS): malformed frames
         # get a clean schema error instead of a KeyError mid-handler.
         schema_err = _schema_validate(method, msg)
@@ -741,11 +746,17 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001 — errors propagate to caller
             import traceback
 
+            _event_stats().record(
+                method, queue_s, time.monotonic() - t_start, error=True
+            )
             if mid:
                 conn.reply(
                     mid, {"_error": f"{e}\n{traceback.format_exc()}"}
                 )
             return
+        _event_stats().record(
+            method, queue_s, time.monotonic() - t_start
+        )
         if result is not DEFERRED and mid:
             conn.reply(mid, result or {})
 
@@ -852,8 +863,10 @@ class Connection:
         self._enqueue(self._DISCONNECT)
 
     def _enqueue(self, item) -> None:
+        # The enqueue timestamp feeds per-handler queueing-delay stats
+        # (event_stats.py — the asio loop-lag analog).
         with self._queue_lock:
-            self._queue.append(item)
+            self._queue.append((item, time.monotonic()))
             if self._draining:
                 return
             self._draining = True
@@ -865,12 +878,12 @@ class Connection:
                 if not self._queue:
                     self._draining = False
                     return
-                item = self._queue.popleft()
+                item, t_enq = self._queue.popleft()
             if item is self._DISCONNECT:
                 self._server._on_disconnect(self)
                 continue
             try:
-                self._server._dispatch(self, item)
+                self._server._dispatch(self, item, t_enq)
             except Exception:
                 pass
 

@@ -17,8 +17,12 @@ execution; restore also evicts the env's modules from sys.modules so
 shared workers stay clean. The hermetic deployment has no package
 index, so requirements must resolve offline (local wheels/dirs) —
 network installs surface as RuntimeEnvSetupError exactly like a failed
-pip would. `conda`/`uv` raise RuntimeEnvSetupError: not installed in
-the image; `pip` is the supported installer.
+pip would.
+
+`uv` and `conda` ship as RuntimeEnvPlugin implementations (reference:
+runtime_env/uv.py, conda.py) gated on their binaries being on PATH —
+validated driver-side for fail-fast on images that don't carry them.
+Third-party extensions subclass RuntimeEnvPlugin and register_plugin().
 """
 
 from __future__ import annotations
@@ -26,6 +30,8 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import pickle
+import shutil
 import sys
 import zipfile
 from contextlib import contextmanager
@@ -36,18 +42,139 @@ from .. import exceptions as exc
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 _CACHE_ROOT = "/tmp/rt_runtime_env_cache"
 
-# Extension point (reference: runtime_env/plugin.py): name -> callable
-# (value, context_dict) -> None, run worker-side inside apply.
-PLUGINS: Dict[str, Any] = {}
-
 _KNOWN_FIELDS = {
     "env_vars",
     "working_dir",
     "py_modules",
     "pip",
-    "conda",
-    "uv",
 }
+
+
+class RuntimeEnvContext:
+    """Mutation surface handed to plugins worker-side. Changes made
+    through it are recorded into apply_runtime_env's save/restore
+    state, so a shared task worker returns to a clean slate; direct
+    os.environ/sys.path writes from a plugin would leak."""
+
+    def __init__(self, worker, saved_env: Dict[str, Any]):
+        self.worker = worker
+        self._saved_env = saved_env
+
+    def set_env(self, key: str, value: str) -> None:
+        self._saved_env.setdefault(key, os.environ.get(key))
+        os.environ[key] = str(value)
+
+    def prepend_sys_path(self, path: str) -> None:
+        # apply_runtime_env snapshots the whole sys.path; prepends are
+        # rolled back wholesale.
+        sys.path.insert(0, path)
+        self.set_env(
+            "PYTHONPATH",
+            os.pathsep.join(
+                p for p in (path, os.environ.get("PYTHONPATH")) if p
+            ),
+        )
+
+
+class RuntimeEnvPlugin:
+    """Extension point (reference: runtime_env/plugin.py
+    RuntimeEnvPlugin — name, priority, create/modify_context hooks).
+
+    A plugin owns one runtime_env key. Lifecycle:
+      * validate(value, worker) — DRIVER-side at submit: check the
+        value, package/upload anything local, return the wire form.
+      * create(wire_value, worker) — WORKER-side, once per distinct
+        wire value per process (memoized on the pickled value):
+        expensive materialization (build an env, download) happens
+        here; the return value is the plugin's state.
+      * modify_context(state, wire_value, ctx) — WORKER-side on every
+        task apply: activate the state via the RuntimeEnvContext
+        (env vars, sys.path); keep it cheap.
+    Plugins run in ascending `priority` (built-in fields first)."""
+
+    name: str = ""
+    priority: int = 10
+
+    def validate(self, value, worker):
+        return value
+
+    def create(self, value, worker):
+        return None
+
+    def modify_context(self, state, value, ctx: RuntimeEnvContext):
+        pass
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+#: (plugin name, pickled wire value) -> created state, per process.
+_plugin_state: Dict[tuple, Any] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name or plugin.name in _KNOWN_FIELDS:
+        raise ValueError(
+            f"plugin name {plugin.name!r} is empty or shadows a "
+            f"built-in runtime_env field"
+        )
+    _PLUGINS[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    _PLUGINS.pop(name, None)
+
+
+_external_loaded = False
+_external_error: Optional[BaseException] = None
+
+
+def _load_external_plugins() -> None:
+    """Load plugins named by RT_RUNTIME_ENV_PLUGINS (comma-separated
+    `module.path:ClassName` or `/abs/file.py:ClassName`) — reference:
+    RAY_RUNTIME_ENV_PLUGINS. Driver and workers are separate
+    processes; the env var (inherited through the daemon's worker
+    env) is what makes a registration visible on both sides.
+
+    A load failure is latched and re-raised on EVERY later call: a
+    typo'd entry must keep failing tasks loudly, not fail once and
+    then let everything run without the plugin's environment."""
+    global _external_loaded, _external_error
+    if _external_loaded:
+        if _external_error is not None:
+            raise exc.RuntimeEnvSetupError(
+                f"RT_RUNTIME_ENV_PLUGINS failed to load: "
+                f"{_external_error}"
+            ) from _external_error
+        return
+    spec = os.environ.get("RT_RUNTIME_ENV_PLUGINS", "")
+    try:
+        for item in filter(None, (s.strip() for s in spec.split(","))):
+            path, _, clsname = item.partition(":")
+            if not clsname:
+                raise exc.RuntimeEnvSetupError(
+                    f"RT_RUNTIME_ENV_PLUGINS entry {item!r} must be "
+                    "module:ClassName or /file.py:ClassName"
+                )
+            import importlib
+            import importlib.util
+
+            if path.endswith(".py"):
+                modname = "_rt_env_plugin_" + hashlib.sha256(
+                    path.encode()
+                ).hexdigest()[:8]
+                loaded = importlib.util.spec_from_file_location(
+                    modname, path
+                )
+                mod = importlib.util.module_from_spec(loaded)
+                sys.modules[modname] = mod
+                loaded.loader.exec_module(mod)
+            else:
+                mod = importlib.import_module(path)
+            register_plugin(getattr(mod, clsname)())
+    except BaseException as e:
+        _external_error = e
+        _external_loaded = True
+        raise
+    _external_loaded = True
 
 
 def _zip_dir(path: str, prefix: str = "") -> bytes:
@@ -78,16 +205,10 @@ def prepare_runtime_env(
     embedded in the task spec."""
     if not env:
         return None
-    unknown = set(env) - _KNOWN_FIELDS - set(PLUGINS)
+    _load_external_plugins()
+    unknown = set(env) - _KNOWN_FIELDS - set(_PLUGINS)
     if unknown:
         raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
-    for banned in ("conda", "uv"):
-        if env.get(banned):
-            raise exc.RuntimeEnvSetupError(
-                f"runtime_env[{banned!r}] is unsupported: {banned} is "
-                "not installed in this image; use runtime_env['pip'] "
-                "or bake dependencies into the image"
-            )
     wire: Dict[str, Any] = {}
     if env.get("pip"):
         wire["pip"] = _normalize_pip(env["pip"], worker)
@@ -104,9 +225,9 @@ def prepare_runtime_env(
             _upload_dir(m, worker, nest_under_name=True)
             for m in env["py_modules"]
         ]
-    for name in PLUGINS:
+    for name, plugin in _PLUGINS.items():
         if name in env:
-            wire[name] = env[name]
+            wire[name] = plugin.validate(env[name], worker)
     return wire
 
 
@@ -256,16 +377,17 @@ def _looks_like_path(req: str) -> bool:
     )
 
 
-def _ensure_pip_env(pip_wire: dict, worker) -> str:
+def _ensure_pip_env(pip_wire: dict, worker, tool: str = "pip") -> str:
     """Worker-side: build (once per requirements hash per node) an
-    isolated package dir via host `pip install --target` and return it
-    for sys.path prepending. A full virtualenv would add interpreter
-    symlinks nothing executes — the path prepend IS the isolation unit
-    here (the reference swaps worker interpreters instead,
-    runtime_env/pip.py). Concurrency-safe via build-in-tmp-then-rename."""
+    isolated package dir via `pip install --target` (or uv's
+    equivalent) and return it for sys.path prepending. A full
+    virtualenv would add interpreter symlinks nothing executes — the
+    path prepend IS the isolation unit here (the reference swaps
+    worker interpreters instead, runtime_env/pip.py). Concurrency-safe
+    via build-in-tmp-then-rename."""
     import subprocess
 
-    target = os.path.join(_CACHE_ROOT, "pip-" + pip_wire["hash"])
+    target = os.path.join(_CACHE_ROOT, f"{tool}-" + pip_wire["hash"])
     if os.path.isdir(target):
         return target
     # Materialize uploaded local requirements (wheels/source dirs)
@@ -280,40 +402,44 @@ def _ensure_pip_env(pip_wire: dict, worker) -> str:
             reqs.append(_fetch_package(entry["dir"], worker))
     os.makedirs(_CACHE_ROOT, exist_ok=True)
     tmp = target + f".tmp{os.getpid()}"
+    if tool == "uv":
+        # --python pins resolution to the worker's interpreter
+        # (reference: runtime_env/uv.py passes the same).
+        cmd = [
+            "uv", "pip", "install", "--quiet",
+            "--python", sys.executable, "--target", tmp, *reqs,
+        ]
+    else:
+        cmd = [
+            sys.executable, "-m", "pip", "install",
+            "--quiet", "--disable-pip-version-check",
+            "--no-input", "--target", tmp, *reqs,
+        ]
     try:
         os.makedirs(tmp, exist_ok=True)
         try:
             proc = subprocess.run(
-                [
-                    sys.executable, "-m", "pip", "install",
-                    "--quiet", "--disable-pip-version-check",
-                    "--no-input", "--target", tmp,
-                    *reqs,
-                ],
+                cmd,
                 capture_output=True,
                 text=True,
                 timeout=600,
             )
         except subprocess.TimeoutExpired as e:
             raise exc.RuntimeEnvSetupError(
-                f"pip install timed out after 600s for runtime_env"
+                f"{tool} install timed out after 600s for runtime_env"
                 f"{pip_wire['packages']}"
             ) from e
         if proc.returncode != 0:
             raise exc.RuntimeEnvSetupError(
-                "pip install failed for runtime_env"
+                f"{tool} install failed for runtime_env"
                 f"{pip_wire['packages']}:\n{proc.stderr[-2000:]}"
             )
         try:
             os.rename(tmp, target)
         except OSError:
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)  # lost the race
     finally:
         if os.path.isdir(tmp):
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)
     return target
 
@@ -376,9 +502,32 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
             sys.path.insert(0, workdir)
         for pkg in wire.get("py_modules") or []:
             sys.path.insert(0, _fetch_package(pkg, worker))
-        for name, hook in PLUGINS.items():
-            if name in wire:
-                hook(wire[name], {"worker": worker})
+        _load_external_plugins()
+        builtin_wire = {"env_vars", "pip", "working_dir", "py_modules"}
+        orphaned = set(wire) - builtin_wire - set(_PLUGINS)
+        if orphaned:
+            # The driver validated these through a plugin that is not
+            # registered HERE (RT_RUNTIME_ENV_PLUGINS missing from the
+            # worker env). Running without the requested environment
+            # would be a silent wrong answer.
+            raise exc.RuntimeEnvSetupError(
+                f"runtime_env fields {sorted(orphaned)} have no "
+                "registered plugin on this worker; set "
+                "RT_RUNTIME_ENV_PLUGINS cluster-wide"
+            )
+        ctx = RuntimeEnvContext(worker, saved_env)
+        for plugin in sorted(
+            _PLUGINS.values(), key=lambda p: p.priority
+        ):
+            if plugin.name not in wire:
+                continue
+            value = wire[plugin.name]
+            state_key = (plugin.name, pickle.dumps(value))
+            if state_key not in _plugin_state:
+                _plugin_state[state_key] = plugin.create(value, worker)
+            plugin.modify_context(
+                _plugin_state[state_key], value, ctx
+            )
         yield
     finally:
         if restore:
@@ -405,3 +554,173 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
                         str(p).startswith(pip_site) for p in paths
                     ):
                         del sys.modules[name]
+
+
+# ---------------------------------------------------------------------------
+# built-in plugins: uv and conda (reference: runtime_env/uv.py, conda.py)
+# ---------------------------------------------------------------------------
+
+class UvPlugin(RuntimeEnvPlugin):
+    """runtime_env={"uv": ["pkg", ...]} or {"uv": {"packages": [...]}}.
+
+    Same wire shape and node-local cache as `pip` (the spec normalizer
+    and the --target package-dir builder are shared), installed by the
+    uv binary instead. Gated driver-side on `uv` being on PATH so an
+    image without it fails at submit, not on a remote worker."""
+
+    name = "uv"
+    priority = 5
+
+    def validate(self, value, worker):
+        if shutil.which("uv") is None:
+            raise exc.RuntimeEnvSetupError(
+                "runtime_env['uv'] requires the uv binary on PATH; "
+                "this image does not carry it — use runtime_env"
+                "['pip'] or bake dependencies into the image"
+            )
+        return _normalize_pip(value, worker)
+
+    def create(self, value, worker):
+        if shutil.which("uv") is None:
+            raise exc.RuntimeEnvSetupError(
+                "runtime_env['uv']: uv binary missing on worker node"
+            )
+        return _ensure_pip_env(value, worker, tool="uv")
+
+    def modify_context(self, state, value, ctx: RuntimeEnvContext):
+        ctx.prepend_sys_path(state)
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """runtime_env={"conda": {"dependencies": [...]}} builds a prefix
+    env once per spec hash; {"conda": "/path/env.yml"} builds from an
+    environment file; {"conda": "env-name"} activates an existing
+    named env. Activation = prefix bin/ onto PATH + its site-packages
+    onto sys.path (the reference swaps the worker interpreter,
+    conda.py; the path prepend is this runtime's isolation unit).
+    Gated driver-side on the conda binary."""
+
+    name = "conda"
+    priority = 5
+
+    def validate(self, value, worker):
+        if shutil.which("conda") is None:
+            raise exc.RuntimeEnvSetupError(
+                "runtime_env['conda'] requires the conda binary on "
+                "PATH; this image does not carry it — use runtime_env"
+                "['pip'] or bake dependencies into the image"
+            )
+        if isinstance(value, str) and not _looks_like_path(value):
+            return {"kind": "named", "name": value}
+        if isinstance(value, str):
+            path = os.path.realpath(os.path.expanduser(value))
+            if not os.path.isfile(path):
+                raise exc.RuntimeEnvSetupError(
+                    f"conda environment file {value!r} not found"
+                )
+            with open(path, "rb") as f:
+                content = f.read()
+            return {
+                "kind": "file",
+                "content": content,
+                "hash": hashlib.sha256(content).hexdigest()[:16],
+            }
+        if isinstance(value, dict):
+            blob = repr(sorted(value.items())).encode()
+            return {
+                "kind": "spec",
+                "spec": value,
+                "hash": hashlib.sha256(blob).hexdigest()[:16],
+            }
+        raise exc.RuntimeEnvSetupError(
+            "runtime_env['conda'] must be an env name, an environment "
+            f"file path, or a spec dict; got {type(value).__name__}"
+        )
+
+    def create(self, value, worker):
+        import subprocess
+
+        if shutil.which("conda") is None:
+            raise exc.RuntimeEnvSetupError(
+                "runtime_env['conda']: conda binary missing on node"
+            )
+        if value["kind"] == "named":
+            proc = subprocess.run(
+                ["conda", "run", "-n", value["name"], "python", "-c",
+                 "import sys; print(sys.prefix)"],
+                capture_output=True, text=True, timeout=120,
+            )
+            if proc.returncode != 0:
+                raise exc.RuntimeEnvSetupError(
+                    f"conda env {value['name']!r} not activatable:\n"
+                    f"{proc.stderr[-1000:]}"
+                )
+            return proc.stdout.strip()
+        prefix = os.path.join(_CACHE_ROOT, "conda-" + value["hash"])
+        if os.path.isdir(prefix):
+            return prefix
+        os.makedirs(_CACHE_ROOT, exist_ok=True)
+        tmp = prefix + f".tmp{os.getpid()}"
+        try:
+            if value["kind"] == "file":
+                envfile = tmp + ".yml"
+                with open(envfile, "wb") as f:
+                    f.write(value["content"])
+                # No -y: `conda env create` never prompts, and the
+                # flag only exists on conda >= 24.3.
+                cmd = ["conda", "env", "create", "-p", tmp,
+                       "-f", envfile]
+            else:
+                deps = value["spec"].get("dependencies", [])
+                bad = [d for d in deps if not isinstance(d, str)]
+                if bad:
+                    raise exc.RuntimeEnvSetupError(
+                        "conda spec dicts support string dependencies "
+                        f"only (got {bad!r}); nested pip sections need "
+                        "the environment-file form: "
+                        '{"conda": "/path/env.yml"}'
+                    )
+                cmd = ["conda", "create", "-y", "-p", tmp, *deps]
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=1800
+            )
+            if proc.returncode != 0:
+                raise exc.RuntimeEnvSetupError(
+                    f"conda env build failed:\n{proc.stderr[-2000:]}"
+                )
+            try:
+                os.rename(tmp, prefix)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+        finally:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
+            try:
+                os.remove(tmp + ".yml")
+            except OSError:
+                pass
+        return prefix
+
+    def modify_context(self, state, value, ctx: RuntimeEnvContext):
+        import glob
+
+        ctx.set_env(
+            "PATH",
+            os.pathsep.join(
+                p
+                for p in (
+                    os.path.join(state, "bin"),
+                    os.environ.get("PATH"),
+                )
+                if p
+            ),
+        )
+        ctx.set_env("CONDA_PREFIX", state)
+        for site in glob.glob(
+            os.path.join(state, "lib", "python*", "site-packages")
+        ):
+            ctx.prepend_sys_path(site)
+
+
+register_plugin(UvPlugin())
+register_plugin(CondaPlugin())

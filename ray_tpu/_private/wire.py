@@ -233,6 +233,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "cluster_load": {},
     "metrics_record": {"records": list},
     "metrics_summary": {},
+    "event_stats": {},
     # pubsub / log streaming
     "subscribe_logs": {"?channels": list},
     "unsubscribe_logs": {},

@@ -242,6 +242,10 @@ class ShmChannel:
                 f"capacity {self.capacity}; recompile with a larger "
                 "buffer_size_bytes"
             )
+        # One deadline for the WHOLE call: _await may be re-entered
+        # (another thread can consume freed space first), and a
+        # restarted timeout would block past the caller's bound.
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._io_lock:
                 if self._closed:
@@ -265,11 +269,13 @@ class ShmChannel:
                 lambda head, tail: self.capacity - (head - tail)
                 >= record,
                 8,
-                timeout,
+                None if deadline is None
+                else deadline - time.monotonic(),
                 "put",
             )
 
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._io_lock:
                 if self._closed:
@@ -301,7 +307,8 @@ class ShmChannel:
             self._await(
                 lambda head, tail: head - tail >= _LEN,
                 0,
-                timeout,
+                None if deadline is None
+                else deadline - time.monotonic(),
                 "get",
             )
 

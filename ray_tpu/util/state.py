@@ -51,6 +51,16 @@ def summarize() -> dict:
     return _worker().call("state_summary")["summary"]
 
 
+def event_stats() -> dict:
+    """Per-RPC-handler timing stats of the local daemon (count,
+    mean/max execution and queueing delay — reference:
+    src/ray/common/event_stats.cc debug dump). The first place to
+    look when the control plane feels sluggish: a hot row with high
+    exec time is a slow handler; uniformly high queue delay is a
+    starved dispatch pool."""
+    return _worker().call("event_stats")["handlers"]
+
+
 __all__ = [
     "list_nodes",
     "list_actors",
@@ -58,4 +68,5 @@ __all__ = [
     "list_objects",
     "list_placement_groups",
     "summarize",
+    "event_stats",
 ]

@@ -1,0 +1,208 @@
+"""RuntimeEnvPlugin API: uv/conda built-ins (binary-gated) and
+external plugins loaded via RT_RUNTIME_ENV_PLUGINS (reference:
+runtime_env/plugin.py, uv.py, conda.py)."""
+
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu as rt
+
+
+FAKE_UV = textwrap.dedent(
+    """\
+    #!{python}
+    import os, sys
+    # mimic: uv pip install --quiet --python X --target DIR req...
+    args = sys.argv[1:]
+    target = args[args.index("--target") + 1]
+    os.makedirs(target, exist_ok=True)
+    with open(os.path.join(target, "fake_uv_pkg.py"), "w") as f:
+        f.write("MAGIC = 'uv-ok'\\n")
+    """
+)
+
+PLUGIN_MODULE = textwrap.dedent(
+    """\
+    import os
+    from ray_tpu._private.runtime_env import RuntimeEnvPlugin
+
+    class StampPlugin(RuntimeEnvPlugin):
+        name = "stamp"
+        priority = 7
+
+        def validate(self, value, worker):
+            # driver-side normalization is visible to the worker
+            return {{"v": str(value).upper()}}
+
+        def create(self, value, worker):
+            # count create calls: memoization must make this once
+            # per distinct value per worker process
+            with open({counter!r}, "a") as f:
+                f.write("create\\n")
+            return value["v"]
+
+        def modify_context(self, state, value, ctx):
+            ctx.set_env("STAMP_ENV", state)
+    """
+)
+
+
+def test_uv_rejected_without_binary(rt_session, tmp_path):
+    """On an image without the uv binary the gate fails at submit,
+    driver-side (simulated by pointing PATH at an empty dir — this
+    image actually carries uv)."""
+    rt = rt_session
+    import ray_tpu.exceptions as exc
+
+    @rt.remote(runtime_env={"uv": ["anything"]})
+    def nope():
+        return 1
+
+    empty = tmp_path / "emptybin"
+    empty.mkdir()
+    old_path = os.environ.get("PATH", "")
+    os.environ["PATH"] = str(empty)
+    try:
+        with pytest.raises(exc.RuntimeEnvSetupError, match="uv"):
+            nope.remote()
+    finally:
+        os.environ["PATH"] = old_path
+
+
+def _forge_wheel(tmp_path):
+    """Tiny pure-python wheel, fully offline-installable (same forge
+    as tests/test_runtime_env_pip.py)."""
+    import zipfile
+
+    dist = "uvpkg_rt-0.1.dist-info"
+    path = tmp_path / "uvpkg_rt-0.1-py3-none-any.whl"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("uvpkg_rt.py", "VALUE = 'real-uv'\n")
+        zf.writestr(
+            f"{dist}/METADATA",
+            "Metadata-Version: 2.1\nName: uvpkg-rt\nVersion: 0.1\n",
+        )
+        zf.writestr(
+            f"{dist}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: forge\nRoot-Is-Purelib: "
+            "true\nTag: py3-none-any\n",
+        )
+        zf.writestr(
+            f"{dist}/RECORD",
+            f"uvpkg_rt.py,,\n{dist}/METADATA,,\n{dist}/WHEEL,,\n"
+            f"{dist}/RECORD,,\n",
+        )
+    return str(path)
+
+
+def test_uv_real_binary_local_wheel(tmp_path):
+    """This image ships uv: install a forged local wheel through the
+    REAL uv binary, fully offline."""
+    import shutil as _shutil
+
+    if _shutil.which("uv") is None:
+        pytest.skip("no uv binary on this image")
+    wheel = _forge_wheel(tmp_path)
+    rt.init(num_cpus=1)
+    try:
+        @rt.remote(runtime_env={"uv": [wheel]})
+        def use():
+            import uvpkg_rt
+
+            return uvpkg_rt.VALUE
+
+        assert rt.get(use.remote(), timeout=180) == "real-uv"
+    finally:
+        rt.shutdown()
+
+
+def test_uv_fake_binary_end_to_end(tmp_path):
+    """With a uv binary on PATH (faked here), runtime_env={'uv': ...}
+    builds the package dir worker-side and the task imports from it —
+    the full plugin path: driver validate -> worker create ->
+    modify_context."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    uv = bindir / "uv"
+    uv.write_text(FAKE_UV.format(python=sys.executable))
+    uv.chmod(uv.stat().st_mode | stat.S_IEXEC)
+
+    old_path = os.environ.get("PATH", "")
+    os.environ["PATH"] = f"{bindir}{os.pathsep}{old_path}"
+    try:
+        rt.init(num_cpus=2)
+
+        @rt.remote(runtime_env={"uv": ["somepkg==1.0"]})
+        def use():
+            import fake_uv_pkg
+
+            return fake_uv_pkg.MAGIC
+
+        assert rt.get(use.remote(), timeout=120) == "uv-ok"
+    finally:
+        os.environ["PATH"] = old_path
+        rt.shutdown()
+
+
+def test_external_plugin_lifecycle(tmp_path):
+    """A plugin shipped via RT_RUNTIME_ENV_PLUGINS=/file.py:Class:
+    driver-side validate transforms the value, worker-side create is
+    memoized per value, modify_context applies through the context
+    (and the env does NOT leak into tasks without the field)."""
+    counter = tmp_path / "creates.txt"
+    plugin_py = tmp_path / "stamp_plugin.py"
+    plugin_py.write_text(
+        PLUGIN_MODULE.format(counter=str(counter))
+    )
+
+    os.environ["RT_RUNTIME_ENV_PLUGINS"] = f"{plugin_py}:StampPlugin"
+    import ray_tpu._private.runtime_env as renv
+
+    renv._external_loaded = False  # re-read the env var
+    try:
+        rt.init(num_cpus=1)
+
+        @rt.remote(runtime_env={"stamp": "hello"})
+        def stamped():
+            return os.environ.get("STAMP_ENV")
+
+        @rt.remote
+        def plain():
+            return os.environ.get("STAMP_ENV")
+
+        # validate() uppercased driver-side; modify_context applied.
+        assert rt.get(stamped.remote(), timeout=60) == "HELLO"
+        assert rt.get(stamped.remote(), timeout=60) == "HELLO"
+        # restore: a task without the field sees a clean worker.
+        assert rt.get(plain.remote(), timeout=60) is None
+        # create() memoized: two applies of the same value, one build
+        # (single worker: num_cpus=1 serializes onto one process).
+        assert counter.read_text().count("create") == 1
+    finally:
+        os.environ.pop("RT_RUNTIME_ENV_PLUGINS", None)
+        renv._external_loaded = False
+        renv.unregister_plugin("stamp")
+        rt.shutdown()
+
+
+def test_register_plugin_validates_names():
+    from ray_tpu._private.runtime_env import (
+        RuntimeEnvPlugin,
+        register_plugin,
+    )
+
+    class Bad(RuntimeEnvPlugin):
+        name = "pip"  # shadows a built-in
+
+    with pytest.raises(ValueError, match="shadows"):
+        register_plugin(Bad())
+
+    class Empty(RuntimeEnvPlugin):
+        name = ""
+
+    with pytest.raises(ValueError):
+        register_plugin(Empty())
