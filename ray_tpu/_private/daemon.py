@@ -219,6 +219,14 @@ class NodeDaemon:
         self.labels = dict(labels or {})
 
         self._lock = threading.RLock()
+        # Core metrics (reference: stats/metric_defs.cc central
+        # registry): monotonic event counters bumped at the few sites
+        # where things happen; gauges computed at scrape
+        # (metric_defs.collect).
+        from .metric_defs import CoreCounters
+
+        self.core_counters = CoreCounters()
+        self.started_at = time.time()
         self.objects: Dict[ObjectID, ObjectEntry] = {}
         self.tasks: Dict[TaskID, TaskEntry] = {}
         self.actor_hosts: Dict[ActorID, ActorHost] = {}
@@ -360,6 +368,7 @@ class NodeDaemon:
             "metrics_record",
             "metrics_summary",
             "event_stats",
+            "profile_worker",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -390,6 +399,7 @@ class NodeDaemon:
             "release_lease",
             "actor_address",
             "task_event",
+            "task_counts",
             # tracing spans (all nodes forward to the head's ring)
             "span_event",
             "list_spans",
@@ -615,6 +625,9 @@ class NodeDaemon:
             return {"ok": False, "unknown_node": True}
         info.last_heartbeat = time.time()
         info.alive = True  # a heartbeating node is alive
+        self.core_counters.bump("heartbeats")
+        if "core_metrics" in msg:
+            info.core_metrics = dict(msg["core_metrics"])
         version = int(msg.get("version", 0))
         if "available" in msg:
             # Payload present: apply + ack this version. Liveness-only
@@ -664,6 +677,7 @@ class NodeDaemon:
         version = 0
         last_acked = -1
         last_state = None
+        beats = 0
         while not self._shutdown:
             try:
                 state = (
@@ -684,6 +698,14 @@ class NodeDaemon:
                         available=state[0], total=state[1],
                         queued=state[2],
                     )
+                # Core metrics ride changed-state beats plus a slow
+                # refresh tick, so idle nodes still stay liveness-only
+                # on the wire most of the time (metric_defs docstring).
+                if version != last_acked or beats % 20 == 0:
+                    from .metric_defs import collect
+
+                    kwargs["core_metrics"] = collect(self)
+                beats += 1
                 reply = self.head.call("node_heartbeat", **kwargs)
                 if reply.get("acked_version") == version:
                     last_acked = version
@@ -848,6 +870,7 @@ class NodeDaemon:
             # Drivers attach to the head (enforced at register); a
             # lease request reaching a worker node is out of contract.
             return {"unavailable": True}
+        self.core_counters.bump("lease_requests")
         resources = dict(msg.get("resources") or {})
         request = ResourceSet(resources)
         if not request.fits_in(self.scheduler.total()):
@@ -1227,6 +1250,7 @@ class NodeDaemon:
         """Serve a chunk of a locally-stored object (reference:
         PushManager chunking, object_manager/push_manager.h)."""
         oid = ObjectID(msg["oid"])
+        self.core_counters.bump("pushes")
         offset = msg.get("offset", 0)
         length = msg.get("length", self.config.object_transfer_chunk_size)
         with self._lock:
@@ -1988,6 +2012,10 @@ class NodeDaemon:
             return True  # concurrent pull won
         except Exception:
             return False
+        self.core_counters.bump("pulls")
+        self.core_counters.bump(
+            "pull_chunks", max(1, -(-size // chunk_size))
+        )
         window = max(1, min(
             8,
             self.config.object_pull_max_bytes_in_flight // chunk_size,
@@ -2359,6 +2387,9 @@ class NodeDaemon:
                 return {}
             entry.state = "DONE"
         spec = entry.spec
+        self.core_counters.bump(
+            "tasks_failed" if msg.get("had_error") else "tasks_finished"
+        )
         self._record_task_event(
             spec, "FAILED" if msg.get("had_error") else "FINISHED"
         )
@@ -2377,6 +2408,7 @@ class NodeDaemon:
         spec = msg["spec"]
         if not self.is_head:
             return self.head.call("create_actor", spec=spec)
+        self.core_counters.bump("actors_created")
         actor_id = ActorID(spec["actor_id"])
         info = ActorInfo(
             actor_id=actor_id,
@@ -2814,6 +2846,7 @@ class NodeDaemon:
         if can_restart:
             with self._lock:
                 runtime.info.num_restarts += 1
+                self.core_counters.bump("actor_restarts")
                 runtime.info.state = ACTOR_RESTARTING
                 runtime.node = None
             self.control.update_actor_state(actor_id, ACTOR_RESTARTING)
@@ -3610,6 +3643,7 @@ class NodeDaemon:
         self._lock). The actual fork/exec happens on the spawner
         thread — its pipe handshake must never stall dispatch."""
         self._spawning += 1
+        self.core_counters.bump("workers_started")
         if self._spawn_thread is None:
             self._spawn_thread = threading.Thread(
                 target=self._spawn_loop, daemon=True,
@@ -3865,6 +3899,67 @@ class NodeDaemon:
 
         return {"handlers": stats().snapshot()}
 
+    def _h_profile_worker(self, conn, msg):
+        """Attach an on-demand profiler to a live worker (reference:
+        dashboard reporter profile_manager.py py-spy/memray attach;
+        here the worker profiles itself in-process —
+        _private/profiling.py — reached over its direct endpoint).
+        Routing: pid alone targets this node; (node_id, pid) routes
+        driver -> head -> owning daemon. Blocks one RPC pool thread
+        for the profile window (rare, operator-driven)."""
+        pid = msg["pid"]
+        node_id = msg.get("node_id")
+        fwd = {
+            k: msg[k]
+            for k in ("pid", "kind", "duration_s", "hz", "top")
+            if k in msg
+        }
+        timeout = float(msg.get("duration_s", 5.0)) + 30.0
+        if node_id and node_id != self.node_id.binary():
+            if not self.is_head:
+                return self.head.call(
+                    "profile_worker",
+                    timeout=timeout,
+                    node_id=node_id,
+                    **fwd,
+                )
+            client = self._node_client(node_id)
+            if client is None:
+                raise ValueError(
+                    f"no live node {NodeID(node_id).hex()}"
+                )
+            return client.call(
+                "profile_worker", timeout=timeout, **fwd
+            )
+        with self._lock:
+            worker = next(
+                (
+                    w
+                    for w in self.workers.values()
+                    if w.pid == pid and w.direct_address
+                ),
+                None,
+            )
+        if worker is None:
+            raise ValueError(
+                f"no local worker with pid {pid} (pass node_id to "
+                f"profile a worker on another node)"
+            )
+        client = RpcClient(worker.direct_address)
+        try:
+            return client.call(
+                "profile",
+                timeout=timeout,
+                kind=msg.get("kind", "stack"),
+                **{
+                    k: msg[k]
+                    for k in ("duration_s", "hz", "top")
+                    if k in msg
+                },
+            )
+        finally:
+            client.close()
+
     def _h_list_task_events(self, conn, msg):
         if not self.is_head:
             return self.head.call(
@@ -4012,6 +4107,7 @@ class NodeDaemon:
         retries or fails its task."""
         import signal
 
+        self.core_counters.bump("oom_kills")
         try:
             os.kill(victim["pid"], signal.SIGKILL)
         except ProcessLookupError:
@@ -4073,6 +4169,66 @@ class NodeDaemon:
                     for tags, bucket in entry["by_tags"].items()
                 }
                 out[name] = clean
+        # Core runtime metrics (reference: stats/metric_defs.cc):
+        # head scrapes itself; worker nodes' latest snapshots rode
+        # heartbeats. Aggregate = sum across nodes, per-node detail
+        # under by_node.
+        from .metric_defs import (
+            CORE_METRICS,
+            GAUGE_AGGREGATION,
+            collect,
+        )
+
+        core_by_node = {self.node_id.hex(): collect(self)}
+        for info in self.control.nodes.values():
+            if info.is_head or not info.alive:
+                continue
+            if info.core_metrics:
+                core_by_node[info.node_id.hex()] = info.core_metrics
+        for name, (kind, unit, desc) in CORE_METRICS.items():
+            values = {
+                nid: m[name]
+                for nid, m in core_by_node.items()
+                if name in m
+            }
+            if not values:
+                continue
+            entry = {
+                "kind": kind,
+                "unit": unit,
+                "description": desc,
+                "by_node": values,
+            }
+            agg = (
+                "sum"
+                if kind == "counter"
+                else GAUGE_AGGREGATION.get(name, "sum")
+            )
+            if agg == "max":
+                total = max(values.values())
+            elif agg == "mean":
+                # Request-weighted: an idle node's lifetime mean must
+                # not dilute a busy node's.
+                weights = {
+                    nid: m.get("rt_rpc_requests_total", 0.0)
+                    for nid, m in core_by_node.items()
+                    if nid in values
+                }
+                weight_sum = sum(weights.values())
+                if weight_sum > 0:
+                    total = (
+                        sum(
+                            values[nid] * weights[nid]
+                            for nid in values
+                        )
+                        / weight_sum
+                    )
+                else:
+                    total = sum(values.values()) / len(values)
+            else:
+                total = sum(values.values())
+            entry["total" if kind == "counter" else "value"] = total
+            out[name] = entry
         return {"metrics": out}
 
     def _h_task_event(self, conn, msg):
@@ -4089,6 +4245,20 @@ class NodeDaemon:
             return {}
         for event in msg["events"]:
             self.control.add_task_event(event)
+        return {}
+
+    def _h_task_counts(self, conn, msg):
+        """Batched direct-transport completion counts from local
+        workers (independent of the disableable task-event stream;
+        metric_defs rt_tasks_*_total). Counted on THIS daemon —
+        by_node attribution shows where the task ran; daemon-
+        scheduled tasks count on the head via _h_task_finished."""
+        self.core_counters.bump(
+            "tasks_finished", int(msg.get("finished", 0))
+        )
+        self.core_counters.bump(
+            "tasks_failed", int(msg.get("failed", 0))
+        )
         return {}
 
     def _h_span_event(self, conn, msg):
@@ -4111,6 +4281,8 @@ class NodeDaemon:
             return {"spans": list(self._spans)[-limit:]}
 
     def _record_task_event(self, spec: dict, state: str) -> None:
+        if state == "RETRY":
+            self.core_counters.bump("tasks_retried")
         if not self.config.task_events_enabled:
             return
         if not self.is_head:

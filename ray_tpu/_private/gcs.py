@@ -97,6 +97,9 @@ class NodeInfo:
     # gossip feeding ClusterResourceManager).
     available: Dict[str, float] = field(default_factory=dict)
     queued: int = 0
+    #: Latest core-metrics snapshot (metric_defs.collect) that rode a
+    #: heartbeat; the head merges these into metrics_summary.
+    core_metrics: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass

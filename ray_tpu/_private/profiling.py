@@ -1,0 +1,158 @@
+"""In-process on-demand profilers.
+
+Reference: python/ray/dashboard/modules/reporter/profile_manager.py —
+the dashboard attaches py-spy (CPU stacks / flamegraph) or memray
+(allocations) to a live worker on demand. Neither tool ships in this
+environment, and both need ptrace or an injected allocator; the
+TPU-native rebuild profiles from INSIDE the worker instead — every
+worker already runs an RPC server, so the profilers are pure-Python
+handlers over interpreter introspection:
+
+  cpu    — wall-clock stack sampler over sys._current_frames at a
+           fixed rate; emits collapsed/folded stacks ("a;b;c N"), the
+           flamegraph.pl / speedscope interchange format py-spy's
+           --format raw produces.
+  memory — tracemalloc window: top allocation sites grouped by
+           traceback between start and stop.
+  stack  — one immediate dump of every thread's Python stack
+           (py-spy dump equivalent).
+
+In-process sampling observes only Python frames (a thread stuck in C
+shows its last Python frame — same blind spot py-spy --native=false
+has) and costs nothing while not attached.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Dict, List, Optional
+
+
+def dump_stacks() -> str:
+    """All threads' current Python stacks as text."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: List[str] = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        out.append(
+            f"--- thread {ident} ({names.get(ident, '?')}) ---"
+        )
+        out.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame)
+        )
+    return "\n".join(out)
+
+
+def _folded(frame) -> str:
+    """One sampled stack, root-first, flamegraph-collapsed."""
+    parts: List[str] = []
+    while frame is not None:
+        code = frame.f_code
+        parts.append(
+            f"{code.co_name} "
+            f"({code.co_filename.rsplit('/', 1)[-1]}"
+            f":{frame.f_lineno})"
+        )
+        frame = frame.f_back
+    return ";".join(reversed(parts))
+
+
+def sample_cpu(
+    duration_s: float = 5.0,
+    hz: float = 100.0,
+    exclude_thread: Optional[int] = None,
+) -> dict:
+    """Sample all threads for `duration_s` at `hz`.
+
+    Returns {"folded": "stack N\n...", "samples": n, "threads": k}.
+    The sampler thread excludes itself (and optionally the caller's
+    RPC thread) so the profile shows the profilee, not the profiler.
+    """
+    duration_s = min(float(duration_s), 120.0)
+    interval = 1.0 / max(1.0, min(float(hz), 1000.0))
+    me = threading.get_ident()
+    counts: Counter = Counter()
+    threads_seen: set = set()
+    samples = 0
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me or ident == exclude_thread:
+                continue
+            threads_seen.add(ident)
+            counts[_folded(frame)] += 1
+        samples += 1
+        time.sleep(interval)
+    folded = "\n".join(
+        f"{stack} {n}" for stack, n in counts.most_common()
+    )
+    return {
+        "folded": folded,
+        "samples": samples,
+        "threads": len(threads_seen),
+        "duration_s": duration_s,
+        "hz": hz,
+    }
+
+
+def profile_memory(duration_s: float = 5.0, top: int = 20) -> dict:
+    """tracemalloc window: allocations between start and stop,
+    grouped by allocation site, biggest first."""
+    import tracemalloc
+
+    duration_s = min(float(duration_s), 120.0)
+    was_tracing = tracemalloc.is_tracing()
+    if not was_tracing:
+        tracemalloc.start(10)
+    try:
+        before = tracemalloc.take_snapshot()
+        time.sleep(duration_s)
+        after = tracemalloc.take_snapshot()
+    finally:
+        if not was_tracing:
+            tracemalloc.stop()
+    stats = after.compare_to(before, "traceback")
+    entries = []
+    for stat in stats[: int(top)]:
+        entries.append(
+            {
+                "size_diff_kb": round(stat.size_diff / 1024, 1),
+                "count_diff": stat.count_diff,
+                "traceback": stat.traceback.format(),
+            }
+        )
+    current, peak = (
+        tracemalloc.get_traced_memory()
+        if tracemalloc.is_tracing()
+        else (0, 0)
+    )
+    return {
+        "top": entries,
+        "traced_current_kb": round(current / 1024, 1),
+        "traced_peak_kb": round(peak / 1024, 1),
+        "duration_s": duration_s,
+    }
+
+
+#: RPC surface: kind -> handler(**params). Registered on the worker's
+#: direct server and reachable through the daemon/head `profile_worker`
+#: relay (dashboard /api/profile).
+def run_profile(kind: str, **params) -> dict:
+    if kind == "stack":
+        return {"stacks": dump_stacks()}
+    if kind == "cpu":
+        return sample_cpu(
+            duration_s=params.get("duration_s", 5.0),
+            hz=params.get("hz", 100.0),
+            exclude_thread=params.get("exclude_thread"),
+        )
+    if kind == "memory":
+        return profile_memory(
+            duration_s=params.get("duration_s", 5.0),
+            top=params.get("top", 20),
+        )
+    raise ValueError(f"unknown profile kind: {kind!r}")

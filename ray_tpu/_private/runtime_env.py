@@ -503,8 +503,7 @@ def apply_runtime_env(wire: Optional[dict], worker, *, restore: bool = True):
         for pkg in wire.get("py_modules") or []:
             sys.path.insert(0, _fetch_package(pkg, worker))
         _load_external_plugins()
-        builtin_wire = {"env_vars", "pip", "working_dir", "py_modules"}
-        orphaned = set(wire) - builtin_wire - set(_PLUGINS)
+        orphaned = set(wire) - _KNOWN_FIELDS - set(_PLUGINS)
         if orphaned:
             # The driver validated these through a plugin that is not
             # registered HERE (RT_RUNTIME_ENV_PLUGINS missing from the
@@ -626,6 +625,18 @@ class CondaPlugin(RuntimeEnvPlugin):
                 "hash": hashlib.sha256(content).hexdigest()[:16],
             }
         if isinstance(value, dict):
+            # Only keys create() actually honors may pass: silently
+            # dropping e.g. "name" or a nested pip section would build
+            # a DIFFERENT environment than the spec describes while
+            # the hash pretends otherwise.
+            unsupported = set(value) - {"dependencies", "channels"}
+            if unsupported:
+                raise exc.RuntimeEnvSetupError(
+                    f"conda spec dict keys {sorted(unsupported)} are "
+                    "not supported (supported: dependencies, "
+                    "channels); use the environment-file form "
+                    '({"conda": "/path/env.yml"}) for full specs'
+                )
             blob = repr(sorted(value.items())).encode()
             return {
                 "kind": "spec",
@@ -680,7 +691,13 @@ class CondaPlugin(RuntimeEnvPlugin):
                         "the environment-file form: "
                         '{"conda": "/path/env.yml"}'
                     )
-                cmd = ["conda", "create", "-y", "-p", tmp, *deps]
+                channels = []
+                for channel in value["spec"].get("channels", []):
+                    channels += ["-c", str(channel)]
+                cmd = [
+                    "conda", "create", "-y", "-p", tmp,
+                    *channels, *deps,
+                ]
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=1800
             )

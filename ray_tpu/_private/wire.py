@@ -150,6 +150,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "node_id": bytes, "?version": int,
         "?available": (dict, type(None)),
         "?total": (dict, type(None)), "?queued": int,
+        "?core_metrics": dict,
     },
     "node_resync": {"node_id": bytes, "actors": list, "objects": list},
     "_disconnect": {},
@@ -159,6 +160,15 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "release_lease": {"lease_id": str},
     "actor_address": {"actor_id": bytes},
     "execute_task": {"spec": dict},
+    # on-demand profiling (reference: dashboard reporter
+    # profile_manager — py-spy/memray attach; here in-process)
+    "profile": {
+        "?kind": str, "?duration_s": _num, "?hz": _num, "?top": int,
+    },
+    "profile_worker": {
+        "pid": int, "?kind": str, "?duration_s": _num,
+        "?hz": _num, "?top": int, "?node_id": (bytes, type(None)),
+    },
     # KV
     "kv_put": {
         "key": (str, bytes), "value": bytes, "?ns": str,
@@ -195,6 +205,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "cancel_task": {"task_id": bytes},
     "cancel_local": {"task_id": bytes},
     "task_event": {"events": list},
+    "task_counts": {"?finished": int, "?failed": int},
     "span_event": {"spans": list},
     "list_spans": {"?limit": int},
     # actors

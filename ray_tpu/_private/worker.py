@@ -146,7 +146,43 @@ class CoreWorker:
 
             self._direct_server.register("execute_task", _h_direct_execute)
             self._direct_server.register("ping", lambda conn, msg: {})
+
+            def _h_profile(conn, msg):
+                # Long-running by design (a cpu profile sleeps for its
+                # whole window): run on a dedicated thread and reply
+                # deferred so the RPC hub never blocks (reference:
+                # profile_manager.py attaches py-spy out-of-band).
+                mid = msg["_mid"]
+
+                def run():
+                    try:
+                        from .profiling import run_profile
+
+                        params = {
+                            k: msg[k]
+                            for k in ("duration_s", "hz", "top")
+                            if k in msg
+                        }
+                        result = run_profile(
+                            msg.get("kind", "stack"), **params
+                        )
+                        conn.reply(mid, result)
+                    except Exception as e:  # noqa: BLE001 — to caller
+                        conn.reply(mid, {"_error": repr(e)})
+
+                threading.Thread(
+                    target=run, daemon=True, name="rt-profiler"
+                ).start()
+                return DEFERRED
+
+            self._direct_server.register("profile", _h_profile)
             self._direct_server.start()
+        self._direct_task_counts = {
+            "lock": threading.Lock(),
+            "finished": 0,
+            "failed": 0,
+            "last_flush": 0.0,
+        }
         # Workers give the daemon a LONG connect window: on an
         # overloaded box (10k-actor waves) the daemon's accept thread
         # can go unscheduled for tens of seconds, and a worker that
@@ -1026,6 +1062,7 @@ class CoreWorker:
         """Direct-transport tasks never transit the daemon, so the
         executing worker reports their state events (reference:
         task_event_buffer.h — workers batch events to the GCS)."""
+        self._count_direct_task(failed)
         if not self.config.task_events_enabled:
             return
         tid = spec["task_id"]
@@ -1047,6 +1084,32 @@ class CoreWorker:
                 ],
             )
         except Exception:
+            pass
+
+    def _count_direct_task(self, failed: bool) -> None:
+        """Core-metrics counting decoupled from the (disableable)
+        task-event stream: completions accumulate locally and flush
+        as ONE tiny notify when the task queue drains or 0.5s passes
+        — zero per-task RPC at full throughput, yet counts land
+        promptly after a burst (metric_defs rt_tasks_*_total)."""
+        counts = self._direct_task_counts
+        with counts["lock"]:
+            counts["failed" if failed else "finished"] += 1
+            now = time.monotonic()
+            due = (
+                now - counts["last_flush"] >= 0.5
+                or self._task_queue.empty()
+            )
+            if not due:
+                return
+            finished, failed_n = counts["finished"], counts["failed"]
+            counts["finished"] = counts["failed"] = 0
+            counts["last_flush"] = now
+        try:
+            self._client.notify(
+                "task_counts", finished=finished, failed=failed_n
+            )
+        except Exception:  # noqa: BLE001 — metrics must not raise
             pass
 
     def _execute(self, spec: dict, reply_to=None) -> None:

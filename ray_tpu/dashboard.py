@@ -125,16 +125,31 @@ def _prometheus_text(metrics: dict) -> str:
     for name, entry in metrics.items():
         kind = entry.get("kind")
         safe = name.replace(".", "_").replace("-", "_")
+        if entry.get("description"):
+            lines.append(f"# HELP {safe} {entry['description']}")
         if kind == "counter":
             lines.append(f"# TYPE {safe} counter")
-            lines.append(f"{safe} {entry.get('total', 0.0)}")
+            value_key = "total"
         elif kind == "gauge":
             lines.append(f"# TYPE {safe} gauge")
-            lines.append(f"{safe} {entry.get('value', 0.0)}")
+            value_key = "value"
         else:
             lines.append(f"# TYPE {safe} summary")
             lines.append(f"{safe}_count {entry.get('count', 0)}")
             lines.append(f"{safe}_sum {entry.get('sum', 0.0)}")
+            continue
+        by_node = entry.get("by_node")
+        if by_node:
+            # Core runtime metrics: ONLY per-node labeled series
+            # (reference exports per-node series through each node's
+            # metrics agent). No unlabeled cluster line — it would
+            # double-count under PromQL sum().
+            for node, value in sorted(by_node.items()):
+                lines.append(
+                    f'{safe}{{node="{node}"}} {value}'
+                )
+        else:
+            lines.append(f"{safe} {entry.get(value_key, 0.0)}")
     return "\n".join(lines) + "\n"
 
 
@@ -224,7 +239,36 @@ class Dashboard:
             for r in reversed(records)
         ]
 
+    @staticmethod
+    def _profile(query: str):
+        """On-demand worker profiling (reference: dashboard reporter
+        profile endpoints). /api/profile?pid=N[&kind=cpu|stack|memory]
+        [&duration_s=S][&hz=H][&top=K][&node=<node hex>]."""
+        from urllib.parse import parse_qs
+
+        from .util.state import profile_worker
+
+        params = {
+            k: v[0] for k, v in parse_qs(query or "").items()
+        }
+        if "pid" not in params:
+            raise ValueError("profile requires ?pid=<worker pid>")
+        return profile_worker(
+            int(params["pid"]),
+            kind=params.get("kind", "cpu"),
+            duration_s=float(params.get("duration_s", 5.0)),
+            hz=float(params.get("hz", 100.0)),
+            top=int(params.get("top", 20)),
+            node_id=params.get("node"),
+        )
+
     def _route(self, path: str):
+        if path.startswith("/api/profile"):
+            _, _, query = path.partition("?")
+            payload = json.dumps(
+                self._profile(query), default=str
+            ).encode()
+            return 200, payload, "application/json"
         if path.startswith("/api/"):
             kind = path[len("/api/") :].strip("/")
             data = self._collect(kind)

@@ -353,6 +353,58 @@ class Dataset:
     def to_numpy(self) -> Dict[str, np.ndarray]:
         return rows_to_batch(self.take_all())
 
+    def to_pandas(self):
+        """Materialize into one DataFrame (reference:
+        Dataset.to_pandas)."""
+        import pandas as pd
+
+        return pd.DataFrame(self.take_all())
+
+    def to_arrow(self):
+        """Materialize into one pyarrow Table (reference:
+        Dataset.to_arrow_refs, collapsed to a local table)."""
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(self.take_all())
+
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        device: Optional[str] = None,
+        dtypes=None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as dicts of torch tensors (reference:
+        Dataset.iter_torch_batches). Non-numeric columns pass through
+        unconverted."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            drop_last=drop_last,
+        ):
+            out: Dict[str, Any] = {}
+            for key, column in batch.items():
+                try:
+                    tensor = torch.as_tensor(column)
+                except (TypeError, RuntimeError):
+                    out[key] = column
+                    continue
+                if dtypes is not None:
+                    want = (
+                        dtypes.get(key)
+                        if isinstance(dtypes, dict)
+                        else dtypes
+                    )
+                    if want is not None:
+                        tensor = tensor.to(want)
+                if device is not None:
+                    tensor = tensor.to(device)
+                out[key] = tensor
+            yield out
+
     def stats(self) -> str:
         refs = self._block_refs()
         return (
@@ -400,6 +452,12 @@ class Dataset:
 
     def write_parquet(self, path: str) -> None:
         _write(self, path, "parquet")
+
+    def write_tfrecords(self, path: str) -> None:
+        _write(self, path, "tfrecords")
+
+    def write_numpy(self, path: str, *, column: str = "data") -> None:
+        _write(self, path, "npy", column=column)
 
 
 class GroupedData:
@@ -592,7 +650,7 @@ def _shuffle(
     ]
 
 
-def _write(ds: Dataset, path: str, fmt: str) -> None:
+def _write(ds: Dataset, path: str, fmt: str, **opts) -> None:
     import os
 
     os.makedirs(path, exist_ok=True)
@@ -621,6 +679,19 @@ def _write(ds: Dataset, path: str, fmt: str) -> None:
 
             table = pa.Table.from_pylist(block)
             pq.write_table(table, file_path)
+        elif fmt == "tfrecords":
+            from .tfrecords import encode_example, write_records
+
+            write_records(
+                file_path,
+                (encode_example(row) for row in block),
+            )
+        elif fmt == "npy":
+            column = opts.get("column", "data")
+            np.save(
+                file_path,
+                np.asarray([row[column] for row in block]),
+            )
         return file_path
 
     write_task = rt.remote(num_cpus=1)(write_block)

@@ -61,6 +61,35 @@ def event_stats() -> dict:
     return _worker().call("event_stats")["handlers"]
 
 
+def profile_worker(
+    pid: int,
+    *,
+    kind: str = "cpu",
+    duration_s: float = 5.0,
+    hz: float = 100.0,
+    top: int = 20,
+    node_id: Optional[str] = None,
+) -> dict:
+    """Attach an on-demand profiler to a live worker process
+    (reference: dashboard reporter profile_manager.py — py-spy
+    cpu/stack profiles, memray memory profiles; here in-process,
+    _private/profiling.py). kind: "cpu" (folded flamegraph stacks),
+    "stack" (instant dump), "memory" (tracemalloc window). node_id
+    (hex) targets a worker on another node."""
+    kwargs: dict = {
+        "pid": int(pid),
+        "kind": kind,
+        "duration_s": float(duration_s),
+        "hz": float(hz),
+        "top": int(top),
+    }
+    if node_id is not None:
+        kwargs["node_id"] = bytes.fromhex(node_id)
+    return _worker().call(
+        "profile_worker", timeout=float(duration_s) + 40.0, **kwargs
+    )
+
+
 __all__ = [
     "list_nodes",
     "list_actors",
@@ -69,4 +98,5 @@ __all__ = [
     "list_placement_groups",
     "summarize",
     "event_stats",
+    "profile_worker",
 ]

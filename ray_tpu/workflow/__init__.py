@@ -8,9 +8,13 @@ loaded, only missing/failed steps re-execute. Step identity is the
 node's position in the deterministic topological order plus its
 function name — stable across resubmissions of the same DAG shape.
 
-Scope note: static DAG workflows + per-step retries + resume are
-implemented; dynamic continuations (steps returning new DAGs) and
-virtual actors are out of scope this round and documented as gaps.
+Dynamic continuations (reference: workflow/api.py continuation — a
+step returns `workflow.continuation(sub_dag)` and the workflow keeps
+executing the returned DAG durably, sub-steps namespaced under the
+parent step) and durable virtual actors (reference:
+workflow/virtual_actor semantics: per-actor persistent state, each
+method call a durable step) are implemented on the same storage: see
+`continuation` below and `workflow.virtual_actor`.
 """
 
 from __future__ import annotations
@@ -61,8 +65,22 @@ class _WorkflowStorage:
         except (OSError, json.JSONDecodeError):
             return None
 
+    @staticmethod
+    def _fs_name(step_id: str) -> str:
+        """Deep continuation prefixes grow linearly with depth; past
+        the filename limit, collapse deterministically to a digest +
+        readable tail (same step_id -> same file across resumes)."""
+        if len(step_id) <= 150:
+            return step_id
+        import hashlib
+
+        digest = hashlib.sha1(step_id.encode()).hexdigest()[:16]
+        return f"{digest}-{step_id[-60:]}"
+
     def step_path(self, step_id: str) -> str:
-        return os.path.join(self.dir, f"step-{step_id}.pkl")
+        return os.path.join(
+            self.dir, f"step-{self._fs_name(step_id)}.pkl"
+        )
 
     def has_step(self, step_id: str) -> bool:
         return os.path.exists(self.step_path(step_id))
@@ -77,6 +95,29 @@ class _WorkflowStorage:
         with open(self.step_path(step_id), "rb") as f:
             return pickle.load(f)
 
+    def cont_path(self, step_id: str) -> str:
+        return os.path.join(
+            self.dir, f"cont-{self._fs_name(step_id)}.pkl"
+        )
+
+    def has_continuation(self, step_id: str) -> bool:
+        return os.path.exists(self.cont_path(step_id))
+
+    def save_continuation(
+        self, step_id: str, dag: DAGNode, input_value: Any
+    ) -> None:
+        import cloudpickle
+
+        tmp = self.cont_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump({"dag": dag, "input": input_value}, f)
+        os.replace(tmp, self.cont_path(step_id))
+
+    def load_continuation(self, step_id: str):
+        with open(self.cont_path(step_id), "rb") as f:
+            state = pickle.load(f)
+        return state["dag"], state["input"]
+
     def save_dag(self, dag: DAGNode, input_value: Any) -> None:
         import cloudpickle
 
@@ -89,7 +130,29 @@ class _WorkflowStorage:
         return state["dag"], state["input"]
 
 
-def _step_ids(dag: DAGNode) -> Dict[int, str]:
+class Continuation:
+    """A step's request to keep the workflow going with a new DAG
+    (reference: ray.workflow.continuation — the dynamic-workflow
+    primitive: recursion/loops whose every iteration is durable)."""
+
+    def __init__(self, dag: DAGNode, input_value: Any = None):
+        if not isinstance(dag, DAGNode):
+            raise TypeError(
+                f"continuation() takes a DAG node, got "
+                f"{type(dag).__name__}"
+            )
+        self.dag = dag
+        self.input_value = input_value
+
+
+def continuation(dag: DAGNode, input_value: Any = None) -> Continuation:
+    """Return this from a workflow step to splice `dag` in as the
+    step's durable continuation; the step's final value becomes the
+    continuation DAG's final value."""
+    return Continuation(dag, input_value)
+
+
+def _step_ids(dag: DAGNode, prefix: str = "") -> Dict[int, str]:
     """Deterministic ids keyed by node identity."""
     ids: Dict[int, str] = {}
     for index, node in enumerate(dag.topological_order()):
@@ -97,8 +160,30 @@ def _step_ids(dag: DAGNode) -> Dict[int, str]:
             name = node._rf.underlying.__name__
         else:
             name = type(node).__name__.lower()
-        ids[id(node)] = f"{index:03d}-{name}"
+        ids[id(node)] = f"{prefix}{index:03d}-{name}"
     return ids
+
+
+#: Continuation depth guard: each level is durable AND the walk below
+#: is an iterative trampoline (no Python recursion — a recursive
+#: implementation would hit the interpreter's ~1000-frame limit around
+#: depth ~300 and, worse, crash identically on every resume). The
+#: guard only stops runaway non-terminating loops.
+_MAX_CONTINUATION_DEPTH = 10_000
+
+
+class _Frame:
+    """One DAG being walked; continuations push child frames."""
+
+    __slots__ = ("dag", "order", "ids", "cache", "input_value", "idx")
+
+    def __init__(self, dag: DAGNode, input_value: Any, prefix: str):
+        self.dag = dag
+        self.order = list(dag.topological_order())
+        self.ids = _step_ids(dag, prefix)
+        self.cache: Dict[int, Any] = {}
+        self.input_value = input_value
+        self.idx = 0
 
 
 def _execute(
@@ -107,37 +192,81 @@ def _execute(
     storage: _WorkflowStorage,
 ) -> Any:
     """Walk the DAG; each step's result is durable before dependents
-    run (reference: workflow_executor commit-before-advance)."""
+    run (reference: workflow_executor commit-before-advance). A step
+    returning a Continuation pushes a child frame: the sub-DAG is
+    persisted first (so resume never re-runs the generating step) and
+    executed with sub-steps namespaced under the parent id."""
     import ray_tpu as rt
 
-    ids = _step_ids(dag)
-    cache: Dict[int, Any] = {}
-    for node in dag.topological_order():
-        step_id = ids[id(node)]
+    stack = [_Frame(dag, input_value, "")]
+
+    def push(sub_dag, sub_input, parent_step_id):
+        if len(stack) >= _MAX_CONTINUATION_DEPTH:
+            raise RecursionError(
+                f"workflow continuation depth exceeded "
+                f"{_MAX_CONTINUATION_DEPTH}"
+            )
+        stack.append(
+            _Frame(sub_dag, sub_input, f"{parent_step_id}.")
+        )
+
+    while True:
+        frame = stack[-1]
+        if frame.idx >= len(frame.order):
+            # Frame done: its dag's value either IS the workflow
+            # output or resolves the parent's pending continuation.
+            result = frame.cache[id(frame.dag)]
+            stack.pop()
+            if not stack:
+                return result
+            parent = stack[-1]
+            node = parent.order[parent.idx]
+            step_id = parent.ids[id(node)]
+            storage.save_step(step_id, result)
+            parent.cache[id(node)] = result
+            parent.idx += 1
+            continue
+        node = frame.order[frame.idx]
+        step_id = frame.ids[id(node)]
         if isinstance(node, InputNode):
-            cache[id(node)] = input_value
+            frame.cache[id(node)] = frame.input_value
+            frame.idx += 1
             continue
         if storage.has_step(step_id):
-            cache[id(node)] = storage.load_step(step_id)
+            frame.cache[id(node)] = storage.load_step(step_id)
+            frame.idx += 1
             continue
         if not isinstance(node, FunctionNode):
             raise TypeError(
                 f"workflows support task nodes only, got "
                 f"{type(node).__name__}"
             )
+        if storage.has_continuation(step_id):
+            # Crashed mid-continuation: resume the sub-DAG without
+            # re-running the (already committed) generating step.
+            sub_dag, sub_input = storage.load_continuation(step_id)
+            push(sub_dag, sub_input, step_id)
+            continue
         args = [
-            cache[id(a)] if isinstance(a, DAGNode) else a
+            frame.cache[id(a)] if isinstance(a, DAGNode) else a
             for a in node._bound_args
         ]
         kwargs = {
-            k: cache[id(v)] if isinstance(v, DAGNode) else v
+            k: frame.cache[id(v)] if isinstance(v, DAGNode) else v
             for k, v in node._bound_kwargs.items()
         }
         ref = node._rf.remote(*args, **kwargs)
         value = rt.get(ref, timeout=600)
+        if isinstance(value, Continuation):
+            # Durable before running: resume re-enters the sub-DAG.
+            storage.save_continuation(
+                step_id, value.dag, value.input_value
+            )
+            push(value.dag, value.input_value, step_id)
+            continue
         storage.save_step(step_id, value)
-        cache[id(node)] = value
-    return cache[id(dag)]
+        frame.cache[id(node)] = value
+        frame.idx += 1
 
 
 def run(
@@ -219,9 +348,22 @@ def list_all(*, storage: Optional[str] = None) -> List[dict]:
     return out
 
 
+from .virtual_actor import (  # noqa: E402
+    VirtualActorClass,
+    get_actor,
+    readonly as virtual_actor_readonly,
+    virtual_actor,
+)
+
 __all__ = [
     "run",
     "resume",
+    "continuation",
+    "Continuation",
+    "virtual_actor",
+    "virtual_actor_readonly",
+    "get_actor",
+    "VirtualActorClass",
     "get_status",
     "get_output",
     "list_all",

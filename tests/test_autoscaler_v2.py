@@ -1,0 +1,405 @@
+"""Autoscaler v2 tests.
+
+Reference test model: autoscaler/v2 tests exercise the instance state
+machine and Reconciler against fake providers and synthetic cluster
+states (no cloud, no real nodes), plus one e2e pass against the
+in-process fake multi-node cluster.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig
+from ray_tpu.autoscaler.v2.instance import (
+    Instance,
+    InstanceStatus as S,
+    VALID_TRANSITIONS,
+)
+from ray_tpu.autoscaler.v2.instance_manager import (
+    InstanceManager,
+    InstanceUpdateEvent,
+)
+from ray_tpu.autoscaler.v2.reconciler import (
+    CloudInstance,
+    ProviderError,
+    ReconcileConfig,
+    Reconciler,
+)
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+def test_happy_path_transitions_recorded():
+    inst = Instance(instance_type="cpu")
+    for status in [
+        S.REQUESTED,
+        S.ALLOCATED,
+        S.RAY_RUNNING,
+        S.RAY_STOP_REQUESTED,
+        S.RAY_STOPPING,
+        S.RAY_STOPPED,
+        S.TERMINATING,
+        S.TERMINATED,
+    ]:
+        assert inst.transition(status), status
+    assert [t.status for t in inst.history][0] == S.QUEUED
+    assert inst.history[-1].status == S.TERMINATED
+    assert len(inst.history) == 9
+
+
+def test_invalid_transition_rejected_without_mutation():
+    inst = Instance(instance_type="cpu")
+    assert not inst.transition(S.RAY_RUNNING)  # QUEUED -/-> RUNNING
+    assert inst.status == S.QUEUED
+    assert len(inst.history) == 1
+    # Terminal states go nowhere.
+    inst.transition(S.REQUESTED)
+    inst.transition(S.ALLOCATION_FAILED)
+    for status in S:
+        assert not inst.transition(status)
+
+
+def test_transition_table_is_closed():
+    """Every status appears as a key; every edge target is a status."""
+    assert set(VALID_TRANSITIONS) == set(S)
+    for targets in VALID_TRANSITIONS.values():
+        assert targets <= set(S)
+
+
+# ---------------------------------------------------------------------------
+# instance manager (versioned updates)
+# ---------------------------------------------------------------------------
+
+def test_versioned_update_rejected_on_stale_version():
+    im = InstanceManager()
+    im.update(
+        [InstanceUpdateEvent(instance_type="cpu", new_status=S.QUEUED)]
+    )
+    version, instances = im.get_state()
+    (iid,) = instances
+    # A write with the current version lands...
+    assert im.update(
+        [
+            InstanceUpdateEvent(
+                instance_id=iid, new_status=S.REQUESTED
+            )
+        ],
+        expected_version=version,
+    )
+    # ...a second write computed against the same (now stale) version
+    # is rejected wholesale.
+    assert not im.update(
+        [
+            InstanceUpdateEvent(
+                instance_id=iid, new_status=S.ALLOCATION_FAILED
+            )
+        ],
+        expected_version=version,
+    )
+    assert im.instances()[0].status == S.REQUESTED
+
+
+def test_subscriber_sees_each_applied_transition_once():
+    im = InstanceManager()
+    seen = []
+    im.subscribe(lambda inst, ev: seen.append(ev.new_status))
+    im.update(
+        [InstanceUpdateEvent(instance_type="cpu", new_status=S.QUEUED)]
+    )
+    iid = im.instances()[0].instance_id
+    im.update(
+        [
+            InstanceUpdateEvent(instance_id=iid, new_status=S.REQUESTED),
+            # Invalid edge: dropped, not delivered.
+            InstanceUpdateEvent(
+                instance_id=iid, new_status=S.RAY_STOPPED
+            ),
+        ]
+    )
+    assert seen == [S.QUEUED, S.REQUESTED]
+
+
+# ---------------------------------------------------------------------------
+# reconciler against synthetic reality
+# ---------------------------------------------------------------------------
+
+TYPES = {
+    "cpu": NodeTypeConfig(
+        resources={"CPU": 2.0}, min_workers=0, max_workers=4
+    ),
+    "v5e-16": NodeTypeConfig(
+        resources={"CPU": 1.0, "TPU": 4.0},
+        min_workers=0,
+        max_workers=2,
+        slice_hosts=4,
+    ),
+}
+
+
+def _empty_load(nodes=None, infeasible=None, pgs=None):
+    return {
+        "nodes": nodes or [],
+        "infeasible": infeasible or [],
+        "pending_placement_groups": pgs or [],
+    }
+
+
+def _reconcile(im, cloud=None, load=None, errors=None, cfg=None):
+    return Reconciler.reconcile(
+        im,
+        node_types=TYPES,
+        cloud_instances=cloud or {},
+        load=load or _empty_load(),
+        config=cfg or ReconcileConfig(idle_timeout_s=0.2),
+        provider_errors=errors,
+    )
+
+
+def test_demand_queues_then_requests_instance():
+    im = InstanceManager()
+    _reconcile(im, load=_empty_load(infeasible=[{"CPU": 2.0}]))
+    (inst,) = im.instances()
+    assert inst.instance_type == "cpu"
+    assert inst.status == S.QUEUED
+    # Next pass hands it a launch slot.
+    _reconcile(im, load=_empty_load(infeasible=[{"CPU": 2.0}]))
+    assert im.instances()[0].status == S.REQUESTED
+    # Demand already covered by the pending instance: no extras.
+    assert len(im.instances()) == 1
+
+
+def test_full_lifecycle_to_running_and_idle_scale_down():
+    im = InstanceManager()
+
+    # Stopper subscriber: acknowledge drain immediately (what
+    # AutoscalerV2._on_update does for providers with no drain API).
+    def stopper(inst, ev):
+        if ev.new_status == S.RAY_STOP_REQUESTED:
+            im.update(
+                [
+                    InstanceUpdateEvent(
+                        instance_id=inst.instance_id,
+                        new_status=S.RAY_STOPPING,
+                        details="drain acknowledged",
+                    )
+                ]
+            )
+
+    im.subscribe(stopper)
+    _reconcile(im, load=_empty_load(infeasible=[{"CPU": 2.0}]))
+    _reconcile(im, load=_empty_load(infeasible=[{"CPU": 2.0}]))
+    (inst,) = im.instances()
+
+    # Cloud instance appears, tagged with our instance id.
+    cloud = {
+        "gce-1": CloudInstance("gce-1", "cpu", inst.instance_id)
+    }
+    _reconcile(im, cloud=cloud)
+    assert inst.status == S.ALLOCATED
+    assert inst.cloud_instance_id == "gce-1"
+
+    # Daemon registers with the head -> RAY_RUNNING with node ids.
+    node = {
+        "node_id": "abc123",
+        "labels": {"rt.io/provider-node": "gce-1"},
+        "available": {"CPU": 2.0},
+        "total": {"CPU": 2.0},
+        "queued": 0,
+    }
+    _reconcile(im, cloud=cloud, load=_empty_load(nodes=[node]))
+    assert inst.status == S.RAY_RUNNING
+    assert inst.node_ids == ["abc123"]
+
+    # Busy node never scales down...
+    busy = dict(node, available={"CPU": 0.0})
+    time.sleep(0.25)
+    _reconcile(im, cloud=cloud, load=_empty_load(nodes=[busy]))
+    assert inst.status == S.RAY_RUNNING
+    # ...idle past the timeout drains then reclaims.
+    time.sleep(0.25)
+    _reconcile(im, cloud=cloud, load=_empty_load(nodes=[node]))
+    assert inst.status == S.RAY_STOPPING  # stop ack'd by subscriber
+    _reconcile(im, cloud=cloud, load=_empty_load(nodes=[node]))
+    assert inst.status == S.TERMINATING
+    # Provider drops it -> TERMINATED.
+    _reconcile(im, cloud={}, load=_empty_load())
+    assert inst.status == S.TERMINATED
+
+
+def test_launch_timeout_retries_then_fails():
+    im = InstanceManager()
+    cfg = ReconcileConfig(
+        request_timeout_s=0.0, max_launch_attempts=2
+    )
+    im.update(
+        [InstanceUpdateEvent(instance_type="cpu", new_status=S.QUEUED)]
+    )
+    _reconcile(im, cfg=cfg)  # QUEUED -> REQUESTED
+    (inst,) = im.instances()
+    inst.launch_attempts = 1
+    assert inst.status == S.REQUESTED
+    _reconcile(im, cfg=cfg)  # timeout -> back to QUEUED
+    assert inst.status == S.QUEUED
+    _reconcile(im, cfg=cfg)  # retry -> REQUESTED
+    inst.launch_attempts = 2
+    assert inst.status == S.REQUESTED
+    _reconcile(im, cfg=cfg)  # attempts exhausted
+    assert inst.status == S.ALLOCATION_FAILED
+
+
+def test_launch_error_surfaces_as_retry():
+    im = InstanceManager()
+    im.update(
+        [InstanceUpdateEvent(instance_type="cpu", new_status=S.QUEUED)]
+    )
+    _reconcile(im)
+    (inst,) = im.instances()
+    inst.launch_attempts = 1
+    _reconcile(
+        im,
+        errors=[
+            ProviderError(
+                kind="launch",
+                instance_id=inst.instance_id,
+                details="quota",
+            )
+        ],
+    )
+    assert inst.status == S.QUEUED
+    assert "quota" in inst.history[-1].details
+
+
+def test_vanished_cloud_instance_marks_terminated():
+    im = InstanceManager()
+    im.update(
+        [InstanceUpdateEvent(instance_type="cpu", new_status=S.QUEUED)]
+    )
+    (inst,) = im.instances()
+    inst.transition(S.REQUESTED)
+    inst.transition(S.ALLOCATED)
+    inst.cloud_instance_id = "gce-9"
+    inst.transition(S.RAY_RUNNING)
+    _reconcile(im, cloud={})  # preempted / crashed
+    assert inst.status == S.TERMINATED
+
+
+def test_leaked_cloud_instance_reported():
+    im = InstanceManager()
+    result = _reconcile(
+        im, cloud={"mystery": CloudInstance("mystery", "cpu")}
+    )
+    assert result["leaked"] == ["mystery"]
+
+
+def test_gang_demand_launches_one_slice_instance():
+    """A 4-bundle STRICT_SPREAD TPU gang becomes ONE v5e-16 instance
+    (slice-granular scale-up), not four."""
+    im = InstanceManager()
+    pg = {
+        "strategy": "STRICT_SPREAD",
+        "bundles": [{"TPU": 4.0}] * 4,
+    }
+    _reconcile(im, load=_empty_load(pgs=[pg]))
+    insts = im.instances()
+    assert len(insts) == 1
+    assert insts[0].instance_type == "v5e-16"
+
+
+def test_min_workers_floor_maintained():
+    im = InstanceManager()
+    types = {
+        "cpu": NodeTypeConfig(
+            resources={"CPU": 2.0}, min_workers=2, max_workers=4
+        )
+    }
+    Reconciler.reconcile(
+        im,
+        node_types=types,
+        cloud_instances={},
+        load=_empty_load(),
+        config=ReconcileConfig(),
+    )
+    assert len(im.instances()) == 2
+    # Floor already satisfied by active instances: stable.
+    Reconciler.reconcile(
+        im,
+        node_types=types,
+        cloud_instances={},
+        load=_empty_load(),
+        config=ReconcileConfig(),
+    )
+    assert len(im.instances()) == 2
+
+
+# ---------------------------------------------------------------------------
+# e2e against the in-process fake cluster
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_v2_scales_up_and_down_e2e():
+    import ray_tpu as rt
+    from ray_tpu.autoscaler.v2 import AutoscalingClusterV2
+
+    cluster = AutoscalingClusterV2(
+        head_resources={"CPU": 1.0},
+        worker_node_types={
+            "cpu-worker": {
+                "resources": {"CPU": 2.0, "memory": float(2**30)},
+                "min_workers": 0,
+                "max_workers": 2,
+            },
+        },
+        idle_timeout_s=2.0,
+    )
+    cluster.start()
+    try:
+        rt.init(address=cluster.address)
+        try:
+
+            @rt.remote(num_cpus=2)
+            def heavy():
+                return "ran"
+
+            assert rt.get(heavy.remote(), timeout=90) == "ran"
+            assert cluster.num_workers() >= 1
+            # RAY_RUNNING lands on the reconcile pass AFTER the
+            # daemon registers; poll briefly.
+            deadline = time.time() + 15
+            statuses: set = set()
+            while time.time() < deadline:
+                statuses = {
+                    s["status"]
+                    for s in cluster.autoscaler.summary()
+                }
+                if "RAY_RUNNING" in statuses:
+                    break
+                time.sleep(0.2)
+            assert "RAY_RUNNING" in statuses, statuses
+
+            deadline = time.time() + 45
+            while (
+                time.time() < deadline
+                and cluster.num_workers() > 0
+            ):
+                time.sleep(0.3)
+            assert cluster.num_workers() == 0
+            # The instance record survives with a full audit trail;
+            # TERMINATED lands on the pass after the provider list
+            # empties.
+            deadline = time.time() + 15
+            trail: list = []
+            while time.time() < deadline:
+                trail = cluster.autoscaler.summary()[0][
+                    "transitions"
+                ]
+                if trail[-1]["status"] == "TERMINATED":
+                    break
+                time.sleep(0.2)
+            assert [t["status"] for t in trail][-1] == "TERMINATED"
+        finally:
+            rt.shutdown()
+    finally:
+        cluster.shutdown()
