@@ -341,6 +341,7 @@ class NodeDaemon:
             "register_client",
             "kv_put",
             "kv_get",
+            "kv_del",
             "kv_keys",
             "submit_task",
             "submit_actor_task",
@@ -1051,6 +1052,14 @@ class NodeDaemon:
                 "kv_get", ns=msg.get("ns", ""), key=msg["key"]
             )
         return {"value": self.control.kv_get(msg.get("ns", ""), msg["key"])}
+
+    def _h_kv_del(self, conn, msg):
+        if not self.is_head:
+            return self.head.call(
+                "kv_del", ns=msg.get("ns", ""), key=msg["key"]
+            )
+        self.control.kv_del(msg.get("ns", ""), msg["key"])
+        return {}
 
     def _h_kv_keys(self, conn, msg):
         if not self.is_head:

@@ -175,6 +175,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?overwrite": bool,
     },
     "kv_get": {"key": (str, bytes), "?ns": str},
+    "kv_del": {"key": (str, bytes), "?ns": str},
     "kv_keys": {"?prefix": (str, bytes), "?ns": str},
     # object plane
     "put_inline": {"oid": bytes, "data": bytes},

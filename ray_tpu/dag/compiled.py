@@ -16,10 +16,12 @@ one teardown() at the driver drains the whole pipeline.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..actor import ActorMethod
 from .channels import ShmChannel
+from .tcp_channel import TcpChannel
 from .dag_node import (
     ClassMethodNode,
     DAGNode,
@@ -56,7 +58,10 @@ def dag_exec_loop(
                     args.append(payload)
             if stop:
                 for chan in out_channels:
-                    chan.put(("s", None))
+                    try:
+                        chan.put(("s", None), timeout=5)
+                    except Exception:
+                        pass
                 return "stopped"
             if error is not None:
                 for chan in out_channels:
@@ -71,10 +76,9 @@ def dag_exec_loop(
             for chan in out_channels:
                 chan.put(("v", result))
     finally:
-        for _, value in arg_descs:
-            if not isinstance(value, ShmChannel):
-                continue
-            value.close()
+        for kind, value in arg_descs:
+            if kind == "chan":
+                value.close()
         for chan in out_channels:
             chan.close()
 
@@ -151,20 +155,27 @@ class CompiledDAG:
             if not isinstance(out, ClassMethodNode):
                 raise TypeError("DAG outputs must be actor-method nodes")
 
-        # One SPSC channel per (producer -> consumer) edge.
+        # One SPSC channel per (producer -> consumer) edge. Same-node
+        # edges ride the shm ring; cross-node edges ride a TCP stream
+        # (reference: node_manager.proto:467-469 — mutable objects are
+        # pushed to the reader's node when the edge crosses nodes).
+        placement = self._actor_placements(actor_nodes)
+        driver_node = self._driver_node_id()
         in_descs: Dict[int, List[Tuple[str, Any]]] = {}
         out_chans: Dict[int, List[ShmChannel]] = {
             id(n): [] for n in actor_nodes
         }
         for node in actor_nodes:
             descs: List[Tuple[str, Any]] = []
+            node_placement = placement[node.actor_handle.actor_id.binary()]
             for arg in node._bound_args:
                 if isinstance(arg, InputNode):
-                    chan = self._new_channel()
+                    chan = self._new_channel(driver_node, node_placement)
                     self._input_channels.append(chan)
                     descs.append(("chan", chan))
                 elif isinstance(arg, ClassMethodNode):
-                    chan = self._new_channel()
+                    src = placement[arg.actor_handle.actor_id.binary()]
+                    chan = self._new_channel(src, node_placement)
                     out_chans[id(arg)].append(chan)
                     descs.append(("chan", chan))
                 elif isinstance(arg, DAGNode):
@@ -179,7 +190,15 @@ class CompiledDAG:
                 )
             in_descs[id(node)] = descs
         for out in outputs:
-            chan = self._new_channel()
+            src = placement[out.actor_handle.actor_id.binary()]
+            chan = self._new_channel(src, driver_node)
+            if isinstance(chan, TcpChannel):
+                # Publish the driver's reader address NOW: a stage's
+                # result put() must be able to complete into the TCP
+                # backlog even if the driver never calls get()
+                # (teardown-without-get must not wedge the exec loop
+                # in rendezvous).
+                chan.bind_reader()
             self._output_channels.append(chan)
             out_chans[id(out)].append(chan)
 
@@ -193,10 +212,51 @@ class CompiledDAG:
             )
             self._loop_refs.append(ref)
 
-    def _new_channel(self) -> ShmChannel:
-        chan = ShmChannel(self._buffer)
+    def _new_channel(self, src_node: Optional[str],
+                     dst_node: Optional[str]):
+        if src_node is not None and src_node == dst_node:
+            chan = ShmChannel(self._buffer)
+        else:
+            chan = TcpChannel(self._buffer)
         self._all_channels.append(chan)
         return chan
+
+    @staticmethod
+    def _driver_node_id() -> Optional[str]:
+        from .._private.worker import global_worker
+
+        worker = global_worker()
+        node_id = getattr(worker, "node_id", None)
+        return node_id.hex() if node_id is not None else None
+
+    @staticmethod
+    def _actor_placements(actor_nodes, timeout: float = 30.0):
+        """actor_id -> node_id hex for every DAG actor, polling the
+        control plane until each actor has been placed (a just-created
+        actor may still be leasing a worker)."""
+        from .._private.worker import global_worker
+
+        worker = global_worker()
+        want = {n.actor_handle.actor_id.binary() for n in actor_nodes}
+        deadline = time.monotonic() + timeout
+        placement: Dict[bytes, Optional[str]] = {}
+        while True:
+            rows = worker.call("list_actors")["actors"]
+            placement = {
+                bytes.fromhex(row["actor_id"]): row["node_id"]
+                for row in rows
+                if bytes.fromhex(row["actor_id"]) in want
+            }
+            if len(placement) == len(want) and all(
+                v is not None for v in placement.values()
+            ):
+                return placement
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "compiled DAG: actors not placed within "
+                    f"{timeout}s (have {len(placement)}/{len(want)})"
+                )
+            time.sleep(0.05)
 
     # -- execution -----------------------------------------------------
     def execute(

@@ -1,15 +1,19 @@
-"""HuggingFace checkpoint conversion (Llama + Qwen2 families).
+"""HuggingFace checkpoint conversion (Llama + Qwen2 + Mistral
+families).
 
 The integration-parity role of the reference's framework adapters
 (reference: python/ray/train/huggingface/ — Ray Train wraps HF
 Trainer/accelerate; SURVEY §2.3 Train-integrations row): here the
-integration is TPU-first — convert an HF `LlamaForCausalLM` or
-`Qwen2ForCausalLM` state dict into this framework's stacked-scan
-parameter pytree and run it on the JAX/Pallas stack. The two share a
-skeleton (RMSNorm, SwiGLU, rotate-half RoPE, GQA); Qwen2 adds QKV
-projection biases (cfg.attn_bias). tests/test_hf_parity.py proves
+integration is TPU-first — convert an HF `LlamaForCausalLM`,
+`Qwen2ForCausalLM` or `MistralForCausalLM` state dict into this
+framework's stacked-scan parameter pytree and run it on the
+JAX/Pallas stack. The three share a skeleton (RMSNorm, SwiGLU,
+rotate-half RoPE, GQA); Qwen2 adds QKV projection biases
+(cfg.attn_bias); Mistral converts only with its sliding window
+disabled (v0.3+ checkpoints — an active window would change
+long-context numerics). tests/test_hf_parity.py proves
 numerical parity of the full forward (logits) against transformers'
-reference implementation for both.
+reference implementation for all three.
 
 Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
 so activations right-multiply):
@@ -72,13 +76,20 @@ def config_from_hf(hf_config) -> LlamaConfig:
                 "token"
             )
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "qwen2"):
+    if model_type not in ("llama", "qwen2", "mistral"):
         raise NotImplementedError(
-            f"model_type={model_type!r}: only the llama and qwen2 "
-            "families convert; anything else would need its own "
-            "numerics audit"
+            f"model_type={model_type!r}: only the llama, qwen2 and "
+            "mistral families convert; anything else would need its "
+            "own numerics audit"
         )
-    if getattr(hf_config, "use_sliding_window", False):
+    # Qwen2 gates SWA behind use_sliding_window (default False);
+    # Mistral enables it whenever sliding_window is set (v0.1 ships
+    # 4096; v0.3 ships null). Either way an *active* window changes
+    # long-context numerics this model doesn't implement.
+    if getattr(hf_config, "use_sliding_window", False) or (
+        model_type == "mistral"
+        and getattr(hf_config, "sliding_window", None) is not None
+    ):
         raise NotImplementedError(
             "sliding-window attention is not implemented; converting "
             "would silently change long-context numerics"

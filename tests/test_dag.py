@@ -194,3 +194,116 @@ def test_compiled_throughput_beats_rpc(rt_session):
     # path isn't catastrophically slower than RPC, not a benchmark —
     # zero-margin timing assertions flake on loaded CI hosts.
     assert compiled_time < 2.0 * rpc_time
+
+
+def test_compiled_cross_node_pipeline():
+    """A compiled pipeline whose stages live on DIFFERENT nodes: the
+    stage-to-stage edges must ride TCP channels (KV rendezvous), not
+    same-host shm rings (reference: node_manager.proto:467-469 pushes
+    mutable objects to the reader's node)."""
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+    from ray_tpu.dag.tcp_channel import TcpChannel
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    try:
+        cluster.add_node(num_cpus=2)
+        rt.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+        nodes = sorted(n["node_id"] for n in rt.nodes())
+
+        @rt.remote
+        class Stage:
+            def __init__(self, scale):
+                self.scale = scale
+
+            def apply(self, x):
+                return x * self.scale
+
+        a = Stage.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nodes[0]
+            )
+        ).remote(3)
+        b = Stage.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nodes[1]
+            )
+        ).remote(7)
+        with InputNode() as inp:
+            dag = b.apply.bind(a.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            # The a->b edge crosses nodes; at least one channel must be
+            # a TcpChannel (driver-adjacent edges depend on which node
+            # hosts the driver).
+            assert any(
+                isinstance(c, TcpChannel) for c in compiled._all_channels
+            )
+            refs = [compiled.execute(i) for i in range(6)]
+            assert [r.get(timeout=60) for r in refs] == [
+                i * 21 for i in range(6)
+            ]
+        finally:
+            compiled.teardown()
+        # Actors return to normal RPC service afterwards.
+        assert rt.get(a.apply.remote(5), timeout=20) == 15
+    finally:
+        try:
+            rt.shutdown()
+        finally:
+            cluster.shutdown()
+
+
+def test_compiled_cross_node_teardown_without_get():
+    """teardown() before any ref.get() must not wedge a cross-node
+    stage: the stage's unbounded result put() can only complete if the
+    driver's reader address was published at compile time (the driver
+    binds eagerly; TCP's listen backlog absorbs the record)."""
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    try:
+        cluster.add_node(num_cpus=2)
+        rt.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+        nodes = sorted(n["node_id"] for n in rt.nodes())
+
+        @rt.remote
+        class Stage:
+            def apply(self, x):
+                return x + 1
+
+        a = Stage.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nodes[0]
+            )
+        ).remote()
+        b = Stage.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nodes[1]
+            )
+        ).remote()
+        with InputNode() as inp:
+            dag = b.apply.bind(a.apply.bind(inp))
+        compiled = dag.experimental_compile()
+        compiled.execute(1)  # never read
+        compiled.teardown()
+        # The deadlock symptom was actors never returning to RPC
+        # service (exec loop stuck in rendezvous-poll forever).
+        assert rt.get(a.apply.remote(5), timeout=20) == 6
+        assert rt.get(b.apply.remote(5), timeout=20) == 6
+    finally:
+        try:
+            rt.shutdown()
+        finally:
+            cluster.shutdown()

@@ -379,3 +379,47 @@ def test_parity_at_depth_gqa_bf16():
         "greedy continuation diverged at decidable positions: "
         f"{(~agree & decidable).sum()} of {decidable.sum()}"
     )
+
+
+def _tiny_hf_mistral(n_heads=4, n_kv_heads=2, seed=0,
+                     sliding_window=None):
+    """Mistral: third HF architecture — Llama skeleton, no biases,
+    GQA by default; converts only with the sliding window disabled
+    (how v0.3+ checkpoints ship)."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_kv_heads,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        sliding_window=sliding_window,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    model = MistralForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_mistral_logits_match_transformers_gqa():
+    model = _tiny_hf_mistral(n_heads=4, n_kv_heads=2, seed=11)
+    cfg = config_from_hf(model.config)
+    assert not cfg.attn_bias  # mistral carries no projection biases
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 128, (2, 33), dtype=np.int64)
+    _compare(model, tokens)
+
+
+def test_mistral_active_sliding_window_rejected():
+    """v0.1-style checkpoints (sliding_window=4096) must fail loudly:
+    converting would silently drop the window and change long-context
+    numerics."""
+    model = _tiny_hf_mistral(sliding_window=32)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        config_from_hf(model.config)
