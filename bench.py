@@ -318,14 +318,20 @@ def _timeit(fn, n: int) -> float:
     return n / (time.perf_counter() - t0)
 
 
-def _micro_case(fn, n: int, scale: float = 1.0, digits: int = 1) -> dict:
+def _micro_case(fn, n: int, scale: float = 1.0, digits: int = 1,
+                trials: int = 0) -> dict:
     """Run one micro case MICRO_TRIALS times; report the median rate
     with its IQR so a reader can judge stability, and flag (not hide)
     noisy cases whose spread exceeds MICRO_MAX_SPREAD. `scale`
-    converts calls/s to the case's unit (ops per call, bytes->GB)."""
+    converts calls/s to the case's unit (ops per call, bytes->GB).
+    `trials` overrides MICRO_TRIALS for short-lap cases that need
+    more samples to find a stable median on a busy 1-core box."""
     import statistics
 
-    rates = sorted(_timeit(fn, n) * scale for _ in range(MICRO_TRIALS))
+    rates = sorted(
+        _timeit(fn, n) * scale
+        for _ in range(trials or MICRO_TRIALS)
+    )
     q = statistics.quantiles(rates, n=4) if len(rates) >= 3 else rates
     result = {
         "median": round(statistics.median(rates), digits),
@@ -464,9 +470,15 @@ def run_micro() -> dict:
             dag = echo.ping.bind(inp)
         compiled = experimental_compile(dag)
         try:
-            compiled.execute(1).get(timeout=30)
+            # Longer trials than the RPC cases: a hop is ~45us, and
+            # 200-hop trials were dominated by cold-start (first-lap
+            # worker wake, branch/cache warmup) — the 3x inter-trial
+            # spread VERDICT r4 flagged. 1000 hops amortize it.
+            for _ in range(300):
+                compiled.execute(1).get(timeout=30)
             results["dag_hop_per_s"] = _micro_case(
-                lambda: compiled.execute(1).get(timeout=30), 200
+                lambda: compiled.execute(1).get(timeout=30), 1000,
+                trials=9,
             )
         finally:
             compiled.teardown()

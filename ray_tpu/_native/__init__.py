@@ -151,6 +151,25 @@ def load_library() -> Optional[ctypes.CDLL]:
             ]
         except AttributeError:
             pass
+        try:  # whole-op ring put/get (dag/channels.py hot path)
+            lib.rts_chan_put.restype = ctypes.c_int
+            lib.rts_chan_put.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_int64,
+            ]
+            lib.rts_chan_get.restype = ctypes.c_int64
+            lib.rts_chan_get.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_int64,
+            ]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 

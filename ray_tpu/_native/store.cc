@@ -650,4 +650,126 @@ int rts_futex_wake(void* p, int n) {
   return rc >= 0 ? static_cast<int>(rc) : -errno;
 }
 
+// ---------------------------------------------------------------------------
+// Whole-operation SPSC ring put/get (dag/channels.py hot path).
+//
+// Same segment layout as the Python implementation (three u64s —
+// head/tail/closed — then `capacity` data bytes), so the two
+// implementations interoperate and pure Python remains the fallback
+// when the toolchain is absent. Collapsing one put or get into a
+// single FFI call matters because the Python path pays ~6 ctypes
+// round-trips + interpreter bytecode per hop: measured 39us/hop
+// two-process ping-pong vs a 6.9us OS-pipe floor on the 1-core CI
+// box; this path closes most of that gap (MICROBENCH dag_hop_per_s).
+//
+// Returns: 0 / payload size on success; -EPIPE closed; -ETIMEDOUT
+// deadline passed; -EMSGSIZE record exceeds capacity; -E2BIG caller
+// buffer too small (cannot happen when out_cap >= capacity).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint64_t kChanHeader = 24;
+// Bounded kernel waits so a peer that died WITHOUT setting the closed
+// flag (SIGKILL) is noticed by the next deadline check instead of
+// sleeping forever; close() rings the futex so the common case wakes
+// immediately.
+constexpr int64_t kChanWaitChunkNs = 200 * 1000 * 1000;
+
+inline int64_t mono_now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+
+inline void ring_copy_in(uint8_t* data, uint64_t cap, uint64_t pos,
+                         const uint8_t* src, uint64_t n) {
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  memcpy(data + off, src, first);
+  if (first < n) memcpy(data, src + first, n - first);
+}
+
+inline void ring_copy_out(const uint8_t* data, uint64_t cap, uint64_t pos,
+                          uint8_t* dst, uint64_t n) {
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  memcpy(dst, data + off, first);
+  if (first < n) memcpy(dst + first, data, n - first);
+}
+
+// Wait for the low u32 of the counter at `watch` to leave `snap`;
+// honors an absolute deadline (deadline_ns < 0 = infinite).
+inline int chan_wait(uint64_t* watch, uint32_t snap, int64_t deadline_ns) {
+  int64_t chunk = kChanWaitChunkNs;
+  if (deadline_ns >= 0) {
+    int64_t left = deadline_ns - mono_now_ns();
+    if (left <= 0) return -ETIMEDOUT;
+    if (left < chunk) chunk = left;
+  }
+  struct timespec ts;
+  ts.tv_sec = chunk / 1000000000;
+  ts.tv_nsec = chunk % 1000000000;
+  syscall(SYS_futex, watch, FUTEX_WAIT, snap, &ts, nullptr, 0);
+  return 0;  // EAGAIN/EINTR/timeout chunks all just re-run the loop
+}
+
+}  // namespace
+
+int rts_chan_put(void* base, uint64_t cap, const void* payload,
+                 uint64_t len, int64_t timeout_ns) {
+  uint8_t* b = static_cast<uint8_t*>(base);
+  uint64_t* H = reinterpret_cast<uint64_t*>(b);
+  uint64_t* T = reinterpret_cast<uint64_t*>(b + 8);
+  uint64_t* C = reinterpret_cast<uint64_t*>(b + 16);
+  uint8_t* data = b + kChanHeader;
+  uint64_t record = len + 8;
+  if (record > cap) return -EMSGSIZE;
+  int64_t deadline = timeout_ns < 0 ? -1 : mono_now_ns() + timeout_ns;
+  for (;;) {
+    if (__atomic_load_n(C, __ATOMIC_ACQUIRE)) return -EPIPE;
+    uint64_t head = __atomic_load_n(H, __ATOMIC_RELAXED);  // sole writer
+    uint64_t tail = __atomic_load_n(T, __ATOMIC_ACQUIRE);
+    if (cap - (head - tail) >= record) {
+      ring_copy_in(data, cap, head, reinterpret_cast<uint8_t*>(&len), 8);
+      ring_copy_in(data, cap, head + 8,
+                   static_cast<const uint8_t*>(payload), len);
+      __atomic_store_n(H, head + record, __ATOMIC_RELEASE);
+      syscall(SYS_futex, H, FUTEX_WAKE, INT32_MAX, nullptr, nullptr, 0);
+      return 0;
+    }
+    int rc = chan_wait(T, static_cast<uint32_t>(tail), deadline);
+    if (rc != 0) return rc;
+  }
+}
+
+int64_t rts_chan_get(void* base, uint64_t cap, void* out,
+                     uint64_t out_cap, int64_t timeout_ns) {
+  uint8_t* b = static_cast<uint8_t*>(base);
+  uint64_t* H = reinterpret_cast<uint64_t*>(b);
+  uint64_t* T = reinterpret_cast<uint64_t*>(b + 8);
+  uint64_t* C = reinterpret_cast<uint64_t*>(b + 16);
+  uint8_t* data = b + kChanHeader;
+  int64_t deadline = timeout_ns < 0 ? -1 : mono_now_ns() + timeout_ns;
+  for (;;) {
+    uint64_t head = __atomic_load_n(H, __ATOMIC_ACQUIRE);
+    uint64_t tail = __atomic_load_n(T, __ATOMIC_RELAXED);  // sole reader
+    if (head - tail >= 8) {
+      uint64_t size;
+      ring_copy_out(data, cap, tail, reinterpret_cast<uint8_t*>(&size), 8);
+      if (size > out_cap) return -E2BIG;
+      ring_copy_out(data, cap, tail + 8, static_cast<uint8_t*>(out),
+                    size);
+      __atomic_store_n(T, tail + 8 + size, __ATOMIC_RELEASE);
+      syscall(SYS_futex, T, FUTEX_WAKE, INT32_MAX, nullptr, nullptr, 0);
+      return static_cast<int64_t>(size);
+    }
+    // Drain-before-close: records buffered ahead of a remote close()
+    // are still delivered (matches the Python path's check order).
+    if (__atomic_load_n(C, __ATOMIC_ACQUIRE)) return -EPIPE;
+    int rc = chan_wait(H, static_cast<uint32_t>(head), deadline);
+    if (rc != 0) return rc;
+  }
+}
+
 }  // extern "C"

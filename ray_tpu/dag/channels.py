@@ -36,40 +36,38 @@ import platform as _platform
 _TSO = _platform.machine() in ("x86_64", "AMD64", "i686", "i386")
 
 
-def _atomics():
-    """(load_acquire, store_release) on u64 addresses, from the native
-    library — real fences, correct on any architecture. Falls back to
-    None (plain struct access, safe on x86-TSO where CPython's stores
-    aren't reordered) when the toolchain is unavailable."""
+def _load_native(symbol: str):
+    """The native library if it loads AND exposes `symbol` (older .so
+    builds predate some entry points), else None."""
     try:
         from .._native import load_library
 
         lib = load_library()
-        if lib is not None and hasattr(lib, "rts_load_acq_u64"):
-            return lib.rts_load_acq_u64, lib.rts_store_rel_u64
+        if lib is not None and hasattr(lib, symbol):
+            return lib
     except Exception:
         pass
     return None
 
 
-_ATOMICS = _atomics()
-
-
-def _futex():
-    """(wait, wake) on the low u32 word of a counter, or None. The
-    kernel-sleep half of the doorbell; spin covers the hot path."""
-    try:
-        from .._native import load_library
-
-        lib = load_library()
-        if lib is not None and hasattr(lib, "rts_futex_wait_u32"):
-            return lib.rts_futex_wait_u32, lib.rts_futex_wake
-    except Exception:
-        pass
-    return None
-
-
-_FUTEX = _futex()
+#: (load_acquire, store_release) on u64 addresses — real fences,
+#: correct on any architecture. None falls back to plain struct
+#: access, safe on x86-TSO where CPython's stores aren't reordered.
+_lib = _load_native("rts_load_acq_u64")
+_ATOMICS = (
+    (_lib.rts_load_acq_u64, _lib.rts_store_rel_u64) if _lib else None
+)
+#: (wait, wake) on the low u32 word of a counter — the kernel-sleep
+#: half of the doorbell; spin covers the hot path.
+_lib = _load_native("rts_futex_wait_u32")
+_FUTEX = (_lib.rts_futex_wait_u32, _lib.rts_futex_wake) if _lib else None
+#: Whole-op native ring put/get (store.cc rts_chan_put/get). One FFI
+#: call per operation instead of ~6 plus interpreter work: measured
+#: 39us -> ~25us per two-process ping-pong hop on the 1-core CI box
+#: (vs a 6.9us OS-pipe floor), and the compiled-DAG hop 8.3k -> 23k/s.
+_CHAN_NATIVE = _load_native("rts_chan_put")
+del _lib
+import errno as _errno
 #: Hot-spin budget before sleeping in the kernel: covers the common
 #: compiled-pipeline turnaround (~tens of us) without a syscall. On a
 #: single-CPU machine spinning is counterproductive — the waiter burns
@@ -137,6 +135,17 @@ class ShmChannel:
         # a native atomic load on an unmapped address is a segfault,
         # not an exception.
         self._io_lock = threading.Lock()
+        # Whole-op native path state: reusable receive buffer and the
+        # count of threads currently inside a native call (close()
+        # must not unmap the segment under them). The per-direction
+        # locks serialize concurrent callers of the same operation —
+        # the ring is SPSC, and the native path must keep the Python
+        # path's per-op atomicity (two concurrent getters would race
+        # the shared scratch buffer; two putters the head counter).
+        self._scratch = None
+        self._inflight = 0
+        self._tx_lock = threading.Lock()
+        self._rx_lock = threading.Lock()
 
     # -- counters ------------------------------------------------------
     # Counter reads/writes live inline in put_bytes/get_bytes/_await
@@ -234,7 +243,68 @@ class ShmChannel:
     # the head/tail bump; TSO hardware (x86) preserves that order for
     # plain stores, other architectures publish through the native
     # store-release.
+    # -- whole-op native path ------------------------------------------
+    def _native_enter(self):
+        with self._io_lock:
+            if self._closed:
+                raise ChannelClosedError(self.name)
+            self._inflight += 1
+
+    def _native_exit(self):
+        with self._io_lock:
+            self._inflight -= 1
+
+    def _native_put(self, payload: bytes, timeout: Optional[float]):
+        t_ns = -1 if timeout is None else max(0, int(timeout * 1e9))
+        with self._tx_lock:
+            self._native_enter()
+            try:
+                rc = _CHAN_NATIVE.rts_chan_put(
+                    self._base_addr, self.capacity, payload,
+                    len(payload), t_ns,
+                )
+            finally:
+                self._native_exit()
+        if rc == 0:
+            return
+        if rc == -_errno.EPIPE:
+            raise ChannelClosedError(self.name)
+        if rc == -_errno.ETIMEDOUT:
+            raise ChannelTimeoutError(f"put on {self.name}")
+        if rc == -_errno.EMSGSIZE:
+            raise ValueError(
+                f"message of {len(payload)} bytes exceeds channel "
+                f"capacity {self.capacity}; recompile with a larger "
+                "buffer_size_bytes"
+            )
+        raise RuntimeError(f"native channel put failed: rc={rc}")
+
+    def _native_get(self, timeout: Optional[float]) -> bytes:
+        t_ns = -1 if timeout is None else max(0, int(timeout * 1e9))
+        with self._rx_lock:
+            if self._scratch is None:
+                self._scratch = ctypes.create_string_buffer(
+                    self.capacity
+                )
+            self._native_enter()
+            try:
+                n = _CHAN_NATIVE.rts_chan_get(
+                    self._base_addr, self.capacity, self._scratch,
+                    self.capacity, t_ns,
+                )
+            finally:
+                self._native_exit()
+            if n >= 0:
+                return self._scratch[:n]
+        if n == -_errno.EPIPE:
+            raise ChannelClosedError(self.name)
+        if n == -_errno.ETIMEDOUT:
+            raise ChannelTimeoutError(f"get on {self.name}")
+        raise RuntimeError(f"native channel get failed: rc={n}")
+
     def put_bytes(self, payload: bytes, timeout: Optional[float] = None):
+        if _CHAN_NATIVE is not None:
+            return self._native_put(payload, timeout)
         record = len(payload) + _LEN
         if record > self.capacity:
             raise ValueError(
@@ -275,6 +345,8 @@ class ShmChannel:
             )
 
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:
+        if _CHAN_NATIVE is not None:
+            return self._native_get(timeout)
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._io_lock:
@@ -331,16 +403,35 @@ class ShmChannel:
             self._ring_doorbell(8)
         except Exception:
             pass
-        with self._io_lock:
-            self._closed = True
+        # A thread blocked inside a whole-op native call holds a raw
+        # pointer into the mapping; it has just been woken (closed
+        # flag + doorbells) and will exit with EPIPE — wait it out
+        # before unmapping (unmapping under it would segfault, not
+        # raise). Bounded: native waits re-check in <=200ms chunks.
+        deadline = time.monotonic() + 2.0
+        while True:
+            with self._io_lock:
+                if self._inflight == 0 or time.monotonic() > deadline:
+                    self._closed = True
+                    busy = self._inflight > 0
+                    if not busy:
+                        try:
+                            self._u64.release()
+                        except Exception:
+                            pass
+                        try:
+                            self._shm.close()
+                        except BufferError:
+                            pass
+                    # busy after the grace: leave the mapping in place
+                    # (freed at GC) rather than segfault a straggler.
+                    return
             try:
-                self._u64.release()
+                self._ring_doorbell(0)
+                self._ring_doorbell(8)
             except Exception:
                 pass
-            try:
-                self._shm.close()
-            except BufferError:
-                pass
+            time.sleep(0.001)
 
     def unlink(self) -> None:
         try:

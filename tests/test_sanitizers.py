@@ -72,6 +72,53 @@ p = arena.try_pin(oid(38))
 assert p is not None and bytes(p[1][:3]) == b"new"
 arena.unpin_idx(p[0])
 arena.close(unlink=True)
+# SPSC ring channel ops (rts_chan_put/get): wrap-around boundaries,
+# odd record sizes, cross-process ping-pong, close-while-blocked.
+from ray_tpu.dag.channels import (
+    ShmChannel, ChannelClosedError, ChannelTimeoutError, _CHAN_NATIVE,
+)
+assert _CHAN_NATIVE is not None  # sanitized .so must expose the ops
+
+chan = ShmChannel(4096)
+for size in (0, 1, 7, 8, 9, 1000, 4000):  # 4000+8 < 4096: fits alone
+    payload = bytes(size %% 256 for _ in range(size))
+    chan.put_bytes(payload, timeout=5)
+    assert chan.get_bytes(timeout=5) == payload
+# force many wrap-arounds with back-to-back odd-sized records
+for i in range(200):
+    chan.put_bytes(b"x" * (i %% 517), timeout=5)
+    assert len(chan.get_bytes(timeout=5)) == i %% 517
+try:
+    chan.put_bytes(b"y" * 5000, timeout=1)
+    raise AssertionError("oversized record accepted")
+except ValueError:
+    pass
+try:
+    chan.get_bytes(timeout=0.05)
+    raise AssertionError("empty get returned")
+except ChannelTimeoutError:
+    pass
+
+# cross-process ping-pong + remote close observed by a blocked reader
+pong = ShmChannel(4096)
+child = os.fork()
+if child == 0:
+    for _ in range(300):
+        pong.put_bytes(chan.get_bytes(timeout=10), timeout=10)
+    chan.close()  # shared flag: parent's next get must raise
+    os._exit(0)
+for i in range(300):
+    chan.put_bytes(b"p" * (i %% 97), timeout=10)
+    assert len(pong.get_bytes(timeout=10)) == i %% 97
+os.waitpid(child, 0)
+try:
+    chan.put_bytes(b"z", timeout=5)
+    raise AssertionError("put on closed channel succeeded")
+except ChannelClosedError:
+    pass
+pong.close(); pong.unlink()
+chan.unlink()
+
 print("SANITIZED-SWEEP-OK")
 """
 
