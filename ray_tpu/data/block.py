@@ -14,6 +14,15 @@ from typing import Any, Dict, Iterable, List
 
 import numpy as np
 
+try:  # reference's canonical block format; optional here
+    import pyarrow as _pa
+except ImportError:
+    _pa = None
+try:
+    import pandas as _pd
+except ImportError:
+    _pd = None
+
 Block = List[dict]
 Batch = Dict[str, np.ndarray]
 
@@ -31,7 +40,13 @@ def rows_to_batch(rows: Block) -> Batch:
 
 def batch_to_rows(batch: Any) -> Block:
     """Column-major (dict of arrays/lists) -> rows. Lists of rows pass
-    through; scalars broadcast is not supported (match lengths)."""
+    through; scalars broadcast is not supported (match lengths).
+    pyarrow Tables and pandas DataFrames returned by a map_batches UDF
+    convert too, so `batch_format="pyarrow"/"pandas"` round-trips."""
+    if _pa is not None and isinstance(batch, _pa.Table):
+        return batch.to_pylist()
+    if _pd is not None and isinstance(batch, _pd.DataFrame):
+        return batch.to_dict("records")
     if isinstance(batch, list):
         return batch
     if not isinstance(batch, dict):
@@ -67,6 +82,10 @@ def format_batch(rows: Block, batch_format: str):
         import pandas as pd
 
         return pd.DataFrame(rows)
+    if batch_format == "pyarrow":  # reference's canonical block format
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(rows)
     raise ValueError(f"unknown batch_format {batch_format!r}")
 
 

@@ -61,11 +61,28 @@ class Proxy:
         fallback_ephemeral: bool = True,
         host: str = "127.0.0.1",
         grpc_port: int = None,
+        max_concurrent_requests: int = 256,
+        max_connections: int = 1024,
     ):
         self.port = port
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._routes_ts = 0.0
         self._handles: Dict[Tuple[str, str], Any] = {}
+        # Ingress admission control (reference: proxy.py limits in-
+        # flight requests per proxy and uvicorn bounds connections;
+        # an unbounded thread-per-connection server melts under a
+        # connection flood). Saturated REQUESTS shed with 503 +
+        # Retry-After (the client can act on it); saturated
+        # CONNECTIONS get a raw 503 and a close before a handler
+        # thread is ever spawned.
+        self._request_slots = threading.BoundedSemaphore(
+            max_concurrent_requests
+        )
+        self._conn_count = 0
+        self._conn_lock = threading.Lock()
+        self._max_connections = max_connections
+        self.shed_requests = 0  # observability: /-/healthz surfaces it
+        self.shed_connections = 0
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,29 +92,103 @@ class Proxy:
                 pass
 
             def _serve(self):
-                try:
-                    result = proxy._dispatch(self)
-                except Exception as e:  # noqa: BLE001 — 500 surface
-                    result = (
-                        500,
-                        json.dumps({"error": repr(e)}).encode(),
-                        "application/json",
+                # Non-blocking admission: a saturated proxy answers
+                # immediately instead of queueing unboundedly (a slow
+                # replica would otherwise stack threads until OOM).
+                if not proxy._request_slots.acquire(blocking=False):
+                    with proxy._conn_lock:
+                        proxy.shed_requests += 1
+                    payload = json.dumps(
+                        {"error": "proxy at max_concurrent_requests"}
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Retry-After", "1")
+                    # Close rather than drain: the unread request body
+                    # would otherwise desynchronize this keep-alive
+                    # connection (next "request line" = body bytes),
+                    # and draining would let a slow client occupy the
+                    # very proxy that is shedding load.
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                    self.send_header(
+                        "Content-Type", "application/json"
                     )
-                if result is None:
-                    return  # response already streamed
-                status, payload, ctype = result
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                try:
+                    try:
+                        result = proxy._dispatch(self)
+                    except Exception as e:  # noqa: BLE001 — 500
+                        result = (
+                            500,
+                            json.dumps({"error": repr(e)}).encode(),
+                            "application/json",
+                        )
+                    if result is None:
+                        return  # response already streamed
+                    status, payload, ctype = result
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
+                finally:
+                    proxy._request_slots.release()
 
             do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+        class BoundedThreadingHTTPServer(ThreadingHTTPServer):
+            # Connection cap enforced BEFORE a handler thread spawns:
+            # over the cap, write a minimal 503 and close. Keep-alive
+            # connections hold a slot for their lifetime (like
+            # uvicorn's --limit-concurrency), so the cap bounds proxy
+            # thread count.
+            def process_request(self, request, client_address):
+                with proxy._conn_lock:
+                    if proxy._conn_count >= proxy._max_connections:
+                        proxy.shed_connections += 1
+                        over = True
+                    else:
+                        proxy._conn_count += 1
+                        over = False
+                if over:
+                    try:
+                        request.sendall(
+                            b"HTTP/1.1 503 Service Unavailable\r\n"
+                            b"Connection: close\r\n"
+                            b"Retry-After: 1\r\n"
+                            b"Content-Length: 0\r\n\r\n"
+                        )
+                    except OSError:
+                        pass
+                    # Close via the BASE implementation: this
+                    # connection never incremented the count, so it
+                    # must not flow through the decrementing override.
+                    ThreadingHTTPServer.shutdown_request(self, request)
+                    return
+                super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                # Every admitted connection's close path (handler
+                # thread finally, spawn-failure handle_error) lands
+                # here exactly once.
+                with proxy._conn_lock:
+                    if proxy._conn_count > 0:
+                        proxy._conn_count -= 1
+                super().shutdown_request(request)
 
         import errno
 
         try:
-            self._server = ThreadingHTTPServer((host, port), Handler)
+            self._server = BoundedThreadingHTTPServer(
+                (host, port), Handler
+            )
         except OSError as e:
             if not fallback_ephemeral or e.errno != errno.EADDRINUSE:
                 raise  # real bind failures must surface to the user
@@ -105,7 +196,9 @@ class Proxy:
             # proxies can't all bind the same port there, so extras
             # take an ephemeral one (real multi-host nodes each bind
             # the configured port).
-            self._server = ThreadingHTTPServer((host, 0), Handler)
+            self._server = BoundedThreadingHTTPServer(
+                (host, 0), Handler
+            )
         self.port = self._server.server_address[1]  # resolve port=0
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
@@ -220,6 +313,21 @@ class Proxy:
         from .router import DeploymentHandle
 
         parsed = urlparse(handler.path)
+        if parsed.path == "/-/healthz":
+            # Drain any body so the keep-alive stream stays in sync.
+            length = int(handler.headers.get("Content-Length") or 0)
+            if length:
+                handler.rfile.read(length)
+            return (
+                200,
+                json.dumps({
+                    "status": "ok",
+                    "connections": self._conn_count,
+                    "shed_requests": self.shed_requests,
+                    "shed_connections": self.shed_connections,
+                }).encode(),
+                "application/json",
+            )
         self._refresh_routes()
         match = self._match(parsed.path)
         if match is None:

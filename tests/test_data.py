@@ -372,3 +372,24 @@ def test_streaming_split_through_actor_pool(rt_session):
         for row in it.iter_rows():
             seen.append(int(row["v"]))
     assert sorted(seen) == [2 * i for i in range(80)]
+
+
+def test_pyarrow_batch_format_round_trip(rt_session):
+    """batch_format="pyarrow" hands the UDF an Arrow Table (the
+    reference's canonical block format) and converts the returned
+    Table back into rows."""
+    pa = pytest.importorskip("pyarrow")
+    import ray_tpu.data as data
+
+    ds = data.from_items([{"x": i} for i in range(8)])
+
+    def double(table):
+        assert isinstance(table, pa.Table)
+        return table.set_column(
+            0, "x", pa.array([v * 2 for v in table["x"].to_pylist()])
+        )
+
+    out = ds.map_batches(
+        double, batch_format="pyarrow", batch_size=4
+    ).take_all()
+    assert sorted(r["x"] for r in out) == [i * 2 for i in range(8)]
