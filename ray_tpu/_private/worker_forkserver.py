@@ -291,6 +291,20 @@ def main() -> None:
     from . import runtime_env  # noqa: F401
     from . import accelerators  # noqa: F401
 
+    # The RPC hub/pool layers lazily `from concurrent.futures import
+    # ThreadPoolExecutor` (and the hub imports selectors) on first
+    # use — post-fork in every child. This image ships NO bytecode
+    # cache for them and sets PYTHONDONTWRITEBYTECODE=1, so each of N
+    # workers recompiled the package from source (~30ms of pure CPU a
+    # worker, the dominant cost of an actor-creation storm). Compile
+    # once here; children inherit the warm modules.
+    # NB: `import concurrent.futures` alone does NOT load the
+    # `.thread` submodule (lazy __getattr__ in 3.12) — name the
+    # class so the submodule actually compiles here.
+    from concurrent.futures import ThreadPoolExecutor  # noqa: F401
+    import selectors  # noqa: F401
+    import http.client  # noqa: F401 — serve replicas' first import
+
     try:
         from .._native import load_library
 
