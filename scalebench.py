@@ -21,9 +21,22 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
+
+#: Process-start clock: case-internal budgets must count the SAME
+#: window the orchestrator's subprocess timeout counts (cluster boot
+#: included), or a case computes a result it never lives to print.
+_PROC_START = time.monotonic()
+
+
+def _reap_group(pgid: int) -> None:
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CASE_TIMEOUT = float(os.environ.get("RT_SCALEBENCH_TIMEOUT", "570"))
@@ -294,7 +307,14 @@ def case_actors_10k_16_daemons() -> dict:
     import ray_tpu as rt
     from ray_tpu.cluster_utils import Cluster
 
-    budget = CASE_TIMEOUT_OVERRIDES["actors_10k_16_daemons"] - 120
+    # Deadline counts from PROCESS start (the window the
+    # orchestrator's subprocess timeout measures — cluster boot
+    # included), minus margin to print the result; measuring from a
+    # post-boot t0 once produced a result that was computed but
+    # SIGKILLed before it could be printed.
+    deadline = _PROC_START + CASE_TIMEOUT_OVERRIDES[
+        "actors_10k_16_daemons"
+    ] - 60
     cluster = Cluster(head_resources={"CPU": 1.0})
     try:
         for _ in range(15):
@@ -311,10 +331,14 @@ def case_actors_10k_16_daemons() -> dict:
         pids = set()
         actors = []
         t0 = time.perf_counter()
+        last_wave_s = 0.0
         while len(actors) < target:
-            elapsed = time.perf_counter() - t0
-            if actors and elapsed > budget * 0.85:
+            remaining = deadline - time.monotonic()
+            # Don't start a wave the deadline can't absorb: leave the
+            # slower of (observed wave time x1.3, 90s) in reserve.
+            if actors and remaining < max(90.0, last_wave_s * 1.3):
                 break  # report what the budget PROVED complete
+            wave_t0 = time.monotonic()
             batch = [
                 Slot.options(scheduling_strategy="SPREAD").remote()
                 for _ in range(wave)
@@ -322,10 +346,11 @@ def case_actors_10k_16_daemons() -> dict:
             try:
                 got = rt.get(
                     [a.ping.remote() for a in batch],
-                    timeout=max(60.0, budget - elapsed),
+                    timeout=max(30.0, remaining - 30.0),
                 )
             except rt.exceptions.GetTimeoutError:
                 break  # budget ran out mid-wave: report proven waves
+            last_wave_s = time.monotonic() - wave_t0
             pids.update(got)
             actors.extend(batch)
         dt = time.perf_counter() - t0
@@ -333,7 +358,7 @@ def case_actors_10k_16_daemons() -> dict:
         assert len(pids) == n, (
             f"expected {n} dedicated workers: {len(pids)}"
         )
-        return {
+        result = {
             "n_target": target,
             "n_alive_and_pinged": n,
             "nodes": 16,
@@ -342,6 +367,16 @@ def case_actors_10k_16_daemons() -> dict:
             "rss_mb_head_process": _rss_mb(),
             "unit": "actors/s",
         }
+        if os.environ.get("RT_SCALEBENCH_ORCH_PID") == str(os.getppid()):
+            # Graceful teardown of up to 10k worker processes takes
+            # minutes on one core — longer than the measurement
+            # itself, and a case-timeout mid-teardown once leaked ~6k
+            # processes. Under the orchestrator (which SIGKILLs this
+            # case's process group after reading the result), print
+            # and fast-exit instead.
+            print(json.dumps(result), flush=True)
+            os._exit(0)
+        return result
     finally:
         rt.shutdown()
         cluster.shutdown()
@@ -374,15 +409,23 @@ def case_args_10k_one_task() -> dict:
         rt.shutdown()
 
 
+#: Cases that print their result and os._exit under the orchestrator
+#: instead of gracefully tearing down thousands of workers; the
+#: orchestrator reaps their process group.
+FAST_EXIT_CASES = {"actors_10k_16_daemons"}
+
+#: Light cases run FIRST: the 10k-actor monster ends in a SIGKILL
+#: reap of thousands of processes whose aftermath (load spike, pid
+#: churn) would otherwise pollute whatever runs next.
 CASES = {
-    "tasks_100k_one_daemon": case_tasks_100k_one_daemon,
-    "tasks_1m_queue_one_daemon": case_tasks_1m_queue_one_daemon,
-    "actors_10k_16_daemons": case_actors_10k_16_daemons,
-    "args_10k_one_task": case_args_10k_one_task,
     "get_10k_objects": case_get_10k_objects,
     "args_and_returns_1k": case_args_and_returns_1k,
-    "actors_1k_16_daemons": case_actors_1k_16_daemons,
+    "args_10k_one_task": case_args_10k_one_task,
+    "tasks_100k_one_daemon": case_tasks_100k_one_daemon,
     "broadcast_256mb_8_daemons": case_broadcast_256mb_8_daemons,
+    "actors_1k_16_daemons": case_actors_1k_16_daemons,
+    "tasks_1m_queue_one_daemon": case_tasks_1m_queue_one_daemon,
+    "actors_10k_16_daemons": case_actors_10k_16_daemons,
 }
 
 
@@ -395,22 +438,50 @@ def _run_case_subprocess(name: str) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # runtime-bound: keep off the chip
     env["PALLAS_AXON_POOL_IPS"] = ""
+    # Enables fast-exit teardowns — scoped to OUR direct children via
+    # a ppid handshake, so a leaked env var can't make a hand-run
+    # --case skip teardown with nobody to reap its tree.
+    env["RT_SCALEBENCH_ORCH_PID"] = str(os.getpid())
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (REPO, env.get("PYTHONPATH", "")) if p
     )
     t0 = time.perf_counter()
+    # Own session/process group: a case that times out has spawned an
+    # entire runtime tree (daemons, fork-servers, up to 10k workers) —
+    # killing only the direct child once leaked ~6k processes and
+    # poisoned every later case's numbers. killpg reaps the tree.
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scalebench.py"),
+         "--case", name],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scalebench.py"),
-             "--case", name],
-            capture_output=True,
-            text=True,
-            timeout=case_timeout,
-            env=env,
-            cwd=REPO,
-        )
+        stdout, stderr = proc.communicate(timeout=case_timeout)
     except subprocess.TimeoutExpired:
+        # Child is still unreaped here, so its pid (= pgid) cannot
+        # have been recycled.
+        _reap_group(proc.pid)
+        try:
+            proc.communicate(timeout=30)
+        except Exception:
+            pass
         return {"ok": False, "error": f"timeout after {case_timeout}s"}
+    if name in FAST_EXIT_CASES:
+        # Fast-exit cases skip graceful teardown and leave their
+        # worker tree for us to reap. Only for them: on the normal
+        # path the child is already reaped, and a recycled pid could
+        # otherwise aim SIGKILL at an innocent process group — but a
+        # fast-exit case's tree keeps the group alive (pgid pinned)
+        # until this kill.
+        _reap_group(proc.pid)
+    proc = subprocess.CompletedProcess(
+        proc.args, proc.returncode, stdout, stderr
+    )
     if proc.returncode != 0:
         return {
             "ok": False,
