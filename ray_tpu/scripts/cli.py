@@ -23,6 +23,37 @@ import time
 DEFAULT_INFO_PATH = "/tmp/rt_cluster_info.json"
 
 
+def _run_until_signal(cleanup) -> None:
+    """Foreground service loop: park until SIGTERM/SIGINT, then run
+    `cleanup` (shared by start/up/dashboard)."""
+    stop = {"flag": False}
+
+    def on_term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        cleanup()
+
+
+def _pid_exited(pid: int) -> bool:
+    """True once the process is gone OR a zombie (exited, unreaped by
+    its parent) — os.kill(pid, 0) alone treats zombies as alive."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0] == "Z"
+    except (OSError, IndexError):
+        return True
+
+
 def _resolve_address(args) -> str:
     if getattr(args, "address", None):
         return args.address
@@ -123,23 +154,15 @@ def cmd_start(args) -> None:
         daemon.start()
         print(f"node started, joined head at {head_address}")
 
-    stop = {"flag": False}
-
-    def on_term(*_):
-        stop["flag"] = True
-
-    signal.signal(signal.SIGTERM, on_term)
-    signal.signal(signal.SIGINT, on_term)
-    try:
-        while not stop["flag"]:
-            time.sleep(0.2)
-    finally:
+    def cleanup():
         daemon.shutdown()
         if args.head:
             try:
                 os.remove(args.cluster_info)
             except OSError:
                 pass
+
+    _run_until_signal(cleanup)
 
 
 def cmd_stop(args) -> None:
@@ -239,31 +262,175 @@ def cmd_jobs(args) -> None:
     print(json.dumps(client.list_jobs(), indent=2, default=str))
 
 
+def cmd_logs(args) -> None:
+    from ..job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    try:
+        client.get_job_status(args.job_id)
+    except Exception:
+        # Unknown ids otherwise print nothing with exit 0 — a typo'd
+        # id in a scripted log fetch must fail loudly.
+        sys.exit(f"no such job: {args.job_id}")
+    print(client.get_job_logs(args.job_id), end="")
+
+
+def cmd_memory(args) -> None:
+    """Object-store usage (reference: `ray memory` — the object table
+    with sizes grouped by node, util/state/memory_utils.py)."""
+    _connect(args)
+    from ..util import state
+
+    rows = state.list_objects(limit=args.limit)
+    by_node = {}
+    total = 0
+    for row in rows:
+        node = (row.get("node_id") or "?")[:12]
+        size = int(row.get("size") or 0)
+        total += size
+        agg = by_node.setdefault(node, {"objects": 0, "bytes": 0})
+        agg["objects"] += 1
+        agg["bytes"] += size
+    note = (
+        f" (truncated at --limit {args.limit})"
+        if len(rows) >= args.limit
+        else ""
+    )
+    print(f"{len(rows)} objects, {total / 1e6:.1f} MB total{note}")
+    for node, agg in sorted(by_node.items()):
+        print(
+            f"  node {node}: {agg['objects']} objects, "
+            f"{agg['bytes'] / 1e6:.1f} MB"
+        )
+    if args.verbose:
+        print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_timeline(args) -> None:
+    """Chrome-trace export (reference: `ray timeline`)."""
+    _connect(args)
+    from ..util.tracing import export_timeline
+
+    trace = export_timeline(args.out)
+    print(f"wrote {len(trace)} trace events to {args.out}")
+
+
+def _load_cluster_config(path: str) -> dict:
+    with open(path) as f:
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            return yaml.safe_load(f)
+        return json.load(f)
+
+
+def cmd_up(args) -> None:
+    """Launch an autoscaling cluster from a config file (reference:
+    `ray up cluster.yaml` — autoscaler/_private/commands.py). The
+    head + autoscaler run in THIS process's foreground (use & or a
+    supervisor to daemonize); `down` signals it via the cluster-info
+    file. Provider `fake` boots in-box daemons; provider `gcp_tpu`
+    drives the TPU REST surface — against the hermetic fake service
+    here (production constructs GcpTpuNodeProvider with the real REST
+    transport + credentials)."""
+    from ..autoscaler.cluster import (
+        AutoscalingCluster,
+        TpuAutoscalingCluster,
+    )
+
+    config = _load_cluster_config(args.config)
+    if not isinstance(config, dict):
+        sys.exit(
+            f"cluster config {args.config} must be a mapping "
+            f"(got {type(config).__name__}: empty file?)"
+        )
+    provider = (config.get("provider") or {}).get("type", "fake")
+    if provider == "gcp_tpu":
+        cluster = TpuAutoscalingCluster(
+            head_resources=config.get("head_resources"),
+            tpu_node_types=config.get("tpu_node_types"),
+            idle_timeout_s=float(config.get("idle_timeout_s", 3.0)),
+        )
+    elif provider == "fake":
+        cluster = AutoscalingCluster(
+            head_resources=config.get("head_resources"),
+            worker_node_types=config.get("worker_node_types"),
+            idle_timeout_s=float(config.get("idle_timeout_s", 3.0)),
+        )
+    else:
+        sys.exit(
+            f"unknown provider type {provider!r} (supported: fake, "
+            "gcp_tpu)"
+        )
+    cluster.start()
+    info = {
+        "address": cluster.address,
+        "pid": os.getpid(),
+        "cluster_name": config.get("cluster_name", "rt-cluster"),
+    }
+    with open(args.cluster_info, "w") as f:
+        json.dump(info, f)
+    print(
+        f"cluster up: address={cluster.address} "
+        f"(info in {args.cluster_info}; `python -m ray_tpu down` "
+        "to stop)",
+        flush=True,
+    )
+
+    def cleanup():
+        cluster.shutdown()
+        try:
+            os.unlink(args.cluster_info)
+        except OSError:
+            pass
+
+    _run_until_signal(cleanup)
+
+
+def cmd_down(args) -> None:
+    """Stop a cluster started with `up` (reference: `ray down`)."""
+    try:
+        with open(args.cluster_info) as f:
+            info = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        sys.exit(f"no cluster-info file at {args.cluster_info}")
+    pid = info.get("pid")
+    if not pid:
+        sys.exit("cluster-info file has no pid")
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        print("cluster process already gone; removing stale info file")
+        try:
+            os.unlink(args.cluster_info)
+        except OSError:
+            pass
+        return
+    # Wait for the `up` process to finish its graceful shutdown
+    # (zombie-aware: a supervisor may reap lazily).
+    for _ in range(100):
+        if _pid_exited(pid):
+            print("cluster stopped")
+            return
+        time.sleep(0.1)
+    print(f"cluster pid {pid} still shutting down (SIGTERM sent)")
+
+
 def cmd_dashboard(args) -> None:
     """Serve the dashboard against a running cluster until SIGINT /
     SIGTERM (reference: the head starts ray's dashboard; here it
     attaches to any cluster as a driver)."""
-    import signal
-    import time
-
     rt = _connect(args)
     from ..dashboard import start_dashboard
 
     dash = start_dashboard(port=args.port)
     print(f"dashboard: http://127.0.0.1:{dash.port}", flush=True)
-    stop = {"flag": False}
 
-    def on_term(*_):
-        stop["flag"] = True
-
-    signal.signal(signal.SIGTERM, on_term)
-    signal.signal(signal.SIGINT, on_term)
-    try:
-        while not stop["flag"]:
-            time.sleep(0.2)
-    finally:
+    def cleanup():
         dash.stop()
         rt.shutdown()
+
+    _run_until_signal(cleanup)
 
 
 def main(argv=None) -> None:
@@ -341,6 +508,37 @@ def main(argv=None) -> None:
     p_jobs = sub.add_parser("jobs", help="list submitted jobs")
     p_jobs.add_argument("--address")
     p_jobs.set_defaults(fn=cmd_jobs)
+
+    p_logs = sub.add_parser("logs", help="fetch a job's logs")
+    p_logs.add_argument("job_id")
+    p_logs.add_argument("--address")
+    p_logs.set_defaults(fn=cmd_logs)
+
+    p_mem = sub.add_parser(
+        "memory", help="object-store usage by node"
+    )
+    p_mem.add_argument("--address")
+    p_mem.add_argument("--limit", type=int, default=10000)
+    p_mem.add_argument("-v", "--verbose", action="store_true")
+    p_mem.set_defaults(fn=cmd_memory)
+
+    p_tl = sub.add_parser(
+        "timeline", help="export a chrome trace of task events"
+    )
+    p_tl.add_argument("--address")
+    p_tl.add_argument("--out", default="timeline.json")
+    p_tl.set_defaults(fn=cmd_timeline)
+
+    p_up = sub.add_parser(
+        "up", help="launch an autoscaling cluster from a config file"
+    )
+    p_up.add_argument("config", help="cluster config (.yaml or .json)")
+    p_up.set_defaults(fn=cmd_up)
+
+    p_down = sub.add_parser(
+        "down", help="stop a cluster started with `up`"
+    )
+    p_down.set_defaults(fn=cmd_down)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

@@ -229,3 +229,79 @@ def test_cli_dashboard_serves(tmp_path):
                 proc.wait(timeout=10)
     finally:
         rt.shutdown()
+
+
+def test_cli_up_down_memory_timeline(tmp_path):
+    """`up` boots an autoscaling cluster from a config file, the
+    state-backed commands (`memory`, `timeline`) run against it, and
+    `down` stops it via the cluster-info file (reference: `ray up`/
+    `ray down`/`ray memory`/`ray timeline`)."""
+    info = str(tmp_path / "cluster.json")
+    config = tmp_path / "cluster.yaml"
+    config.write_text(
+        "cluster_name: cli-test\n"
+        "provider:\n  type: fake\n"
+        "head_resources: {CPU: 2.0}\n"
+        "worker_node_types:\n"
+        "  cpu-worker:\n"
+        "    resources: {CPU: 2.0}\n"
+        "    min_workers: 0\n"
+        "    max_workers: 2\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+    up = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+         "up", str(config)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(info):
+            time.sleep(0.2)
+        assert os.path.exists(info), "up never wrote cluster info"
+
+        script = tmp_path / "job.py"
+        script.write_text(
+            "import ray_tpu as rt\n"
+            "rt.init()\n"
+            "print('mem-probe', rt.get(rt.put(b'x' * 100000))[:1])\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "submit", "--timeout", "120", "--",
+             sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "SUCCEEDED" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "memory"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "objects" in out.stdout
+
+        trace_out = tmp_path / "trace.json"
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "timeline", "--out", str(trace_out)],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert trace_out.exists()
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "down"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert up.wait(timeout=30) == 0
+    finally:
+        if up.poll() is None:
+            up.kill()
+            up.wait(timeout=10)
