@@ -416,6 +416,56 @@ def cmd_down(args) -> None:
     print(f"cluster pid {pid} still shutting down (SIGTERM sent)")
 
 
+def cmd_serve_run(args) -> None:
+    """`serve run module:app` (reference: serve/scripts.py:455 — import
+    the bound Application, deploy it, serve until SIGINT/SIGTERM)."""
+    import importlib
+
+    rt = _connect(args)
+    from .. import serve
+
+    module_name, _, attr = args.import_path.partition(":")
+    if not attr:
+        sys.exit(
+            "serve run takes module:attr (e.g. my_app:app, where "
+            "`app = MyDeployment.bind(...)`)"
+        )
+    sys.path.insert(0, os.getcwd())
+    try:
+        app = getattr(importlib.import_module(module_name), attr)
+    except (ImportError, AttributeError) as e:
+        sys.exit(f"cannot import {args.import_path!r}: {e}")
+    # start() returns the ACTUAL bound port: when proxies already
+    # exist (a prior run), --port is a no-op and the live port wins.
+    port = serve.start(http_port=args.port)
+    serve.run(
+        app, name=args.name, route_prefix=args.route_prefix
+    )
+    note = "" if port == args.port else " (existing proxy port kept)"
+    print(
+        f"serving {args.import_path} as app {args.name!r} at "
+        f"http://127.0.0.1:{port}{args.route_prefix}{note}",
+        flush=True,
+    )
+    if args.blocking:
+        _run_until_signal(lambda: (serve.shutdown(), rt.shutdown()))
+
+
+def cmd_serve_status(args) -> None:
+    _connect(args)
+    from .. import serve
+
+    print(json.dumps(serve.status(), indent=2, default=str))
+
+
+def cmd_serve_shutdown(args) -> None:
+    _connect(args)
+    from .. import serve
+
+    serve.shutdown()
+    print("serve shut down")
+
+
 def cmd_dashboard(args) -> None:
     """Serve the dashboard against a running cluster until SIGINT /
     SIGTERM (reference: the head starts ray's dashboard; here it
@@ -539,6 +589,30 @@ def main(argv=None) -> None:
         "down", help="stop a cluster started with `up`"
     )
     p_down.set_defaults(fn=cmd_down)
+
+    p_serve = sub.add_parser("serve", help="model-serving commands")
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    p_srun = serve_sub.add_parser(
+        "run", help="deploy module:app and serve it"
+    )
+    p_srun.add_argument("import_path", help="module:attr of a bound app")
+    p_srun.add_argument("--address")
+    p_srun.add_argument("--name", default="default")
+    p_srun.add_argument("--route-prefix", default="/")
+    p_srun.add_argument("--port", type=int, default=8000)
+    p_srun.add_argument(
+        "--non-blocking", dest="blocking", action="store_false",
+        help="deploy and exit instead of serving in the foreground",
+    )
+    p_srun.set_defaults(fn=cmd_serve_run)
+    p_sstat = serve_sub.add_parser("status", help="serve app status")
+    p_sstat.add_argument("--address")
+    p_sstat.set_defaults(fn=cmd_serve_status)
+    p_sdown = serve_sub.add_parser(
+        "shutdown", help="tear down all serve apps and proxies"
+    )
+    p_sdown.add_argument("--address")
+    p_sdown.set_defaults(fn=cmd_serve_shutdown)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

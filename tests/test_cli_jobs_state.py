@@ -305,3 +305,84 @@ def test_cli_up_down_memory_timeline(tmp_path):
         if up.poll() is None:
             up.kill()
             up.wait(timeout=10)
+
+
+def test_cli_serve_run_status_shutdown(tmp_path):
+    """`serve run module:app` deploys and serves over HTTP; `serve
+    status` reports it; `serve shutdown` tears it down (reference:
+    serve/scripts.py run/status/shutdown)."""
+    import urllib.request
+
+    info = str(tmp_path / "cluster.json")
+    app_py = tmp_path / "cli_app.py"
+    app_py.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Hello:\n"
+        "    def __call__(self, request):\n"
+        "        return {'hello': request.query_params.get('q', '')}\n"
+        "app = Hello.bind()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+         "start", "--head", "--num-cpus", "4", "--num-tpus", "0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    srv = None
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(info):
+            time.sleep(0.2)
+        assert os.path.exists(info)
+
+        import socket as socklib
+
+        with socklib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "serve", "run", "cli_app:app", "--port", str(port)],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 60
+        body = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/?q=cli", timeout=5
+                ) as resp:
+                    body = resp.read()
+                break
+            except Exception:
+                assert srv.poll() is None, srv.stdout.read().decode()
+                time.sleep(0.5)
+        assert body is not None and b"cli" in body, body
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "serve", "status"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "default" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--cluster-info", info,
+             "serve", "shutdown"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+    finally:
+        for proc in (srv, head):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
