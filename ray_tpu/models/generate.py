@@ -25,7 +25,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.norms import apply_rotary, rms_norm, rotary_embedding, swiglu
+from ..ops.norms import apply_rotary, rotary_embedding
+from .llama import embed_tokens, model_glu, model_norm
 from .llama import LlamaConfig, project_qkv
 
 
@@ -59,7 +60,7 @@ def _layer_with_cache(
 ):
     b, t, _ = x.shape
     hd = cfg.head_dim
-    h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+    h = model_norm(cfg, x, layer["attn_norm"])
     q, k, v = project_qkv(cfg, h, layer)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
@@ -93,8 +94,8 @@ def _layer_with_cache(
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vf.astype(jnp.float32))
     attn = attn.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(b, t, -1)
     x = x + attn @ layer["wo"]
-    h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
-    x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+    h = model_norm(cfg, x, layer["mlp_norm"])
+    x = x + model_glu(cfg, h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
     return x, k_cache, v_cache
 
 
@@ -104,7 +105,7 @@ def _forward_with_cache(
     """tokens [b, t] -> (logits [b, t, vocab], new cache)."""
     b, t = tokens.shape
     positions = cache_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = embed_tokens(cfg, params, tokens)
     cos, sin = rotary_embedding(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
@@ -121,7 +122,7 @@ def _forward_with_cache(
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    x = model_norm(cfg, x, params["final_norm"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "length": cache["length"]}
 

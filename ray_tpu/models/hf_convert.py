@@ -1,5 +1,5 @@
-"""HuggingFace checkpoint conversion (Llama + Qwen2 + Mistral
-families).
+"""HuggingFace checkpoint conversion (Llama + Qwen2 + Mistral +
+Gemma families).
 
 The integration-parity role of the reference's framework adapters
 (reference: python/ray/train/huggingface/ — Ray Train wraps HF
@@ -11,9 +11,11 @@ JAX/Pallas stack. The three share a skeleton (RMSNorm, SwiGLU,
 rotate-half RoPE, GQA); Qwen2 adds QKV projection biases
 (cfg.attn_bias); Mistral converts only with its sliding window
 disabled (v0.3+ checkpoints — an active window would change
-long-context numerics). tests/test_hf_parity.py proves
-numerical parity of the full forward (logits) against transformers'
-reference implementation for all three.
+long-context numerics); Gemma-1 swaps in a GeGLU gate, (1+w)
+RMSNorms, a sqrt(dim) embedding scale and a head_dim decoupled from
+dim/n_heads (gemma-2's soft-capping stays loudly unsupported).
+tests/test_hf_parity.py proves numerical parity of the full forward
+(logits) against transformers' reference implementation for all four.
 
 Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
 so activations right-multiply):
@@ -76,11 +78,13 @@ def config_from_hf(hf_config) -> LlamaConfig:
                 "token"
             )
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "qwen2", "mistral"):
+    if model_type not in ("llama", "qwen2", "mistral", "gemma"):
         raise NotImplementedError(
-            f"model_type={model_type!r}: only the llama, qwen2 and "
-            "mistral families convert; anything else would need its "
-            "own numerics audit"
+            f"model_type={model_type!r}: only the llama, qwen2, "
+            "mistral and gemma families convert; anything else would "
+            "need its own numerics audit (gemma2's logit soft-capping "
+            "and alternating sliding windows are NOT implemented — "
+            "converting one would silently change its numerics)"
         )
     # Qwen2 gates SWA behind use_sliding_window (default False);
     # Mistral enables it whenever sliding_window is set (v0.1 ships
@@ -107,8 +111,44 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "projections incl. o_proj) is unsupported; qwen2-style "
             "QKV-only biases are the supported biased layout"
         )
+    # Gemma family: GeGLU gate, (1+w) norms, sqrt(dim) embedding
+    # scale, head_dim decoupled from dim/n_heads, always-tied lm_head.
+    act = "silu"
+    if model_type == "gemma":
+        # transformers' GemmaMLP reads ACT2FN[config.hidden_act]; the
+        # separate hidden_activation field is stored but UNUSED by the
+        # layer — parity means following hidden_act, and a checkpoint
+        # where the two disagree is ambiguous (the 2024-era workaround
+        # configs) and must fail loudly, not silently pick one.
+        mapping = {"gelu_pytorch_tanh": "gelu_tanh", "gelu": "gelu_exact"}
+        hidden_act = getattr(
+            hf_config, "hidden_act", "gelu_pytorch_tanh"
+        ) or "gelu_pytorch_tanh"
+        legacy = getattr(hf_config, "hidden_activation", None)
+        if hidden_act not in mapping:
+            raise NotImplementedError(
+                f"gemma hidden_act={hidden_act!r} unsupported"
+            )
+        if legacy is not None and legacy != hidden_act:
+            raise NotImplementedError(
+                f"gemma config carries conflicting activations "
+                f"(hidden_act={hidden_act!r}, "
+                f"hidden_activation={legacy!r}); converting would "
+                "silently diverge from transformers, which uses "
+                "hidden_act only"
+            )
+        act = mapping[hidden_act]
+    head_dim = getattr(hf_config, "head_dim", 0) or 0
+    if head_dim and head_dim * hf_config.num_attention_heads == (
+        hf_config.hidden_size
+    ):
+        head_dim = 0  # derived — keep the config canonical
     return LlamaConfig(
         attn_bias=model_type == "qwen2",
+        custom_head_dim=head_dim,
+        act=act,
+        norm_offset=model_type == "gemma",
+        embed_scale=model_type == "gemma",
         norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,

@@ -74,10 +74,24 @@ class LlamaConfig:
     #: A tuple (not a dict) so the frozen config stays hashable for
     #: jit static args.
     rope_scaling: Any = None
+    # ---- Gemma-family knobs ----
+    #: Per-head dimension when it is NOT dim//n_heads (Gemma-2B:
+    #: dim 2048, 8 heads, head_dim 256). 0 = derived.
+    custom_head_dim: int = 0
+    #: GLU gate activation: "silu" (Llama/Qwen/Mistral SwiGLU),
+    #: "gelu_tanh" (Gemma GeGLU, torch tanh approximation) or
+    #: "gelu_exact" (erf — what transformers uses when a config says
+    #: plain "gelu").
+    act: str = "silu"
+    #: RMSNorm scales by (1 + w) instead of w (Gemma stores w around
+    #: zero; applying it Llama-style silently zeroes activations).
+    norm_offset: bool = False
+    #: Multiply embedding output by sqrt(dim) (Gemma normalizer).
+    embed_scale: bool = False
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.custom_head_dim or self.dim // self.n_heads
 
     def num_params(self) -> int:
         embed = self.vocab_size * self.dim
@@ -114,6 +128,17 @@ class LlamaConfig:
         return LlamaConfig(**kw)
 
     @staticmethod
+    def gemma_2b(**kw) -> "LlamaConfig":
+        """Gemma-1 2B geometry: GeGLU, (1+w) norms, sqrt(dim) embed
+        scale, head_dim decoupled from dim/n_heads."""
+        return LlamaConfig(
+            vocab_size=256000, dim=2048, n_layers=18, n_heads=8,
+            n_kv_heads=1, intermediate=16384, custom_head_dim=256,
+            act="gelu_tanh", norm_offset=True, embed_scale=True,
+            **kw
+        )
+
+    @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
         return LlamaConfig(
             vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
@@ -133,6 +158,35 @@ class LlamaConfig:
             vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
             n_kv_heads=8, intermediate=2816, max_seq_len=2048, **kw
         )
+
+
+def model_norm(cfg: LlamaConfig, x, weight):
+    """RMSNorm with the family's scale convention — shared by the
+    training layer and the KV-cache serving layer so the two can't
+    diverge (Gemma scales by 1+w; Llama-family by w)."""
+    return rms_norm(
+        x, weight, eps=cfg.norm_eps, offset=1.0 if cfg.norm_offset else 0.0
+    )
+
+
+def model_glu(cfg: LlamaConfig, x, gate):
+    """GLU with the family's gate activation: act(gate) * x."""
+    if cfg.act == "silu":
+        return swiglu(x, gate)
+    if cfg.act == "gelu_tanh":
+        return jax.nn.gelu(gate, approximate=True) * x
+    if cfg.act == "gelu_exact":
+        return jax.nn.gelu(gate, approximate=False) * x
+    raise ValueError(f"unknown activation {cfg.act!r}")
+
+
+def embed_tokens(cfg: LlamaConfig, params, tokens):
+    """Embedding lookup (+ Gemma's sqrt(dim) normalizer, applied in
+    the embedding dtype to match transformers' rounding)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.dim), cfg.dtype)
+    return x
 
 
 def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
@@ -259,14 +313,14 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
     aux is the MoE load-balancing loss (0 for dense layers)."""
     b, t, _ = x.shape
     hd = cfg.head_dim
-    h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+    h = model_norm(cfg, x, layer["attn_norm"])
     q, k, v = project_qkv(cfg, h, layer)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
     attn = _attention(cfg, q, k, v, sp_axis)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, cfg.n_heads * hd)
     x = x + attn @ layer["wo"]
-    h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+    h = model_norm(cfg, x, layer["mlp_norm"])
     if cfg.moe_experts:
         moe_params = {
             "router": layer["router"],
@@ -284,7 +338,7 @@ def _layer(cfg: LlamaConfig, x, layer, cos, sin, sp_axis=None,
             out, aux = moe_ffn_dense(moe_params, flat, k=cfg.moe_top_k)
         x = x + out.reshape(b, t, -1)
     else:
-        x = x + swiglu(h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+        x = x + model_glu(cfg, h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
         aux = jnp.zeros((), jnp.float32)
     return x, aux
 
@@ -307,7 +361,7 @@ def forward_and_aux(
     b, t = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t), (b, t))
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = embed_tokens(cfg, params, tokens)
     cos, sin = rotary_embedding(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
@@ -336,7 +390,7 @@ def forward_and_aux(
         else:
             body = jax.checkpoint(body)
     x, auxs = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps)
+    x = model_norm(cfg, x, params["final_norm"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, jnp.sum(auxs)
 
@@ -405,5 +459,8 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
             cfg.dim * cfg.intermediate
         )
         n -= cfg.n_layers * max(inactive, 0)
-    attn = 12 * cfg.n_layers * cfg.dim * seq_len  # causal factor 1/2 applied
-    return 6.0 * n + attn / 2
+    # QK^T + AV over n_heads*head_dim total attention width — equal to
+    # dim for Llama-family, decoupled for Gemma-style geometries.
+    attn_width = cfg.n_heads * cfg.head_dim
+    attn = 12 * cfg.n_layers * attn_width * seq_len
+    return 6.0 * n + attn / 2  # causal factor 1/2 on the attn term

@@ -11,13 +11,19 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
-    """RMSNorm in f32 accumulation, cast back to the input dtype."""
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6,
+             offset: float = 0.0) -> jax.Array:
+    """RMSNorm in f32 accumulation, cast back to the input dtype.
+    `offset` supports the Gemma convention of scaling by (1 + w)
+    (the checkpoint stores w near zero)."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    scale = weight.astype(jnp.float32)
+    if offset:
+        scale = scale + offset
+    return (normed * scale).astype(dtype)
 
 
 def rope_frequencies(

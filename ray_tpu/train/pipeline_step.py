@@ -35,10 +35,12 @@ except ImportError:  # older jax
 from ..models.llama import (
     LlamaConfig,
     _layer,
+    embed_tokens,
     masked_xent,
+    model_norm,
     param_annotations,
 )
-from ..ops.norms import rms_norm, rotary_embedding
+from ..ops.norms import rotary_embedding
 from ..parallel.pipeline import broadcast_from_last_stage, spmd_pipeline
 from ..parallel.sharding import Annotated
 from .train_step import TrainState, infer_opt_shardings
@@ -141,8 +143,9 @@ def make_pp_train_step(
         )
 
         # Embedding runs on every pp rank (cheap vs the stack); only
-        # rank 0's result is injected into the pipeline.
-        x = params["embed"][tokens].astype(cfg.dtype)
+        # rank 0's result is injected into the pipeline. Shared helper
+        # so family conventions (Gemma sqrt(dim) scale) apply here too.
+        x = embed_tokens(cfg, params, tokens)
         microbatches = x.reshape(num_mb, mb, t_loc, -1)
         stage_layers = jax.tree.map(lambda a: a[0], params["layers"])
 
@@ -177,7 +180,7 @@ def make_pp_train_step(
         aux = lax.psum(aux_local, "pp") / num_mb
         outs = broadcast_from_last_stage(outs, "pp")
         h = outs.reshape(b_loc, t_loc, -1)
-        h = rms_norm(h, params["final_norm"], eps=cfg.norm_eps)
+        h = model_norm(cfg, h, params["final_norm"])
         logits = (h @ params["lm_head"]).astype(jnp.float32)
 
         nll_sum, count = masked_xent(logits, targets)

@@ -423,3 +423,68 @@ def test_mistral_active_sliding_window_rejected():
     model = _tiny_hf_mistral(sliding_window=32)
     with pytest.raises(NotImplementedError, match="sliding-window"):
         config_from_hf(model.config)
+
+
+def _tiny_hf_gemma(n_heads=4, n_kv_heads=1, head_dim=32, seed=0):
+    """Gemma: fourth HF architecture — GeGLU gate, (1+w) RMSNorm,
+    sqrt(dim) embedding scale, head_dim decoupled from dim/n_heads,
+    always-tied lm_head. The tiny config uses head_dim != dim/n_heads
+    on purpose (Gemma-2B ships 8 heads x 256 on dim 2048)."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = GemmaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=n_heads,
+        num_key_value_heads=n_kv_heads,
+        head_dim=head_dim,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    model = GemmaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_gemma_logits_match_transformers():
+    model = _tiny_hf_gemma(seed=13)
+    cfg = config_from_hf(model.config)
+    assert cfg.custom_head_dim == 32  # decoupled: 4 heads x 32 on dim 64
+    assert cfg.act == "gelu_tanh" and cfg.norm_offset and cfg.embed_scale
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, 128, (2, 33), dtype=np.int64)
+    _compare(model, tokens, atol=5e-4)
+
+
+def test_gemma_greedy_decode_matches_transformers_generate():
+    """The KV-cache serving layer applies the Gemma conventions too
+    (shared model_norm/model_glu/embed_tokens helpers)."""
+    from ray_tpu.models.generate import generate
+
+    model = _tiny_hf_gemma(seed=14)
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(1, 128, (2, 9), dtype=np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt),
+            max_new_tokens=10,
+            do_sample=False,
+            pad_token_id=0,
+            eos_token_id=None,
+        )[:, prompt.shape[1]:].numpy()
+    cfg = config_from_hf(model.config)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    ours, _lengths = generate(
+        params,
+        jax.numpy.asarray(prompt),
+        jax.numpy.asarray(np.full(2, prompt.shape[1], np.int32)),
+        cfg,
+        max_new_tokens=10,
+        temperature=0.0,
+    )
+    assert np.asarray(ours).tolist() == ref.tolist()

@@ -129,3 +129,31 @@ def test_moe_dense_matches_shapes_single_device():
         lambda a, b: a + float(jnp.sum(jnp.abs(b))), grads, 0.0
     )
     assert np.isfinite(total) and total > 0
+
+
+def test_pp_loss_matches_nonpp_gemma_conventions():
+    """Regression: the pipeline forward once bypassed the shared
+    family helpers — a Gemma config (sqrt(dim) embed scale, (1+w)
+    final norm, GeGLU, decoupled head_dim) silently computed different
+    numerics under pp than the plain forward."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    cfg = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        custom_head_dim=32, act="gelu_tanh", norm_offset=True,
+        embed_scale=True, intermediate=128, max_seq_len=64,
+        dtype=jnp.float32, attention="reference",
+    )
+    mesh = _mesh(pp=2, sp=1, ep=1)
+    init_fn, step_fn = make_pp_train_step(
+        cfg, mesh, default_optimizer(total_steps=10), num_microbatches=2
+    )
+    state = init_fn(jax.random.PRNGKey(0), lambda k: init_params(k, cfg))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+    )
+    _, metrics = step_fn(state, tokens[:, :-1], tokens[:, 1:])
+    pp_loss = float(metrics["loss"])
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref = float(loss_fn(params, tokens[:, :-1], tokens[:, 1:], cfg))
+    assert abs(pp_loss - ref) < 1e-4, (pp_loss, ref)
