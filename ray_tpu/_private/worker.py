@@ -514,7 +514,7 @@ class CoreWorker:
             entry = self._direct.lookup(oid)
             if entry is not None:
                 fut = entry[0]
-                if fut.event.is_set() and not fut.daemon_fallback:
+                if fut.done() and not fut.daemon_fallback:
                     return fut.error
         try:
             reply = self._client.call(
@@ -661,7 +661,7 @@ class CoreWorker:
                     daemon_refs.append(ref)
                     del direct[ref]
                     remaining.append(ref)
-                elif fut.event.is_set():
+                elif fut.done():
                     ready.append(ref)
                 else:
                     remaining.append(ref)
@@ -683,12 +683,12 @@ class CoreWorker:
             )
             if deadline is not None and slice_t is not None:
                 slice_t = min(slice_t, max(deadline - now, 0.0))
-            pending = [f for f in direct.values() if not f.event.is_set()]
+            pending = [f for f in direct.values() if not f.done()]
             if pending:
                 # Any single completion wakes the wait (each future
                 # sets any_done via its done-callback).
                 any_done.clear()
-                if any(f.event.is_set() for f in pending):
+                if any(f.done() for f in pending):
                     continue  # completed between scan and clear
                 any_done.wait(slice_t)
             elif daemon_refs:
@@ -749,7 +749,7 @@ class CoreWorker:
         if entry is None:
             return ("ref", arg.binary())
         fut, index = entry
-        if fut.event.is_set() and not fut.daemon_fallback:
+        if fut.done() and not fut.daemon_fallback:
             if fut.error is not None:
                 # Publish the error to the daemon table so the
                 # dependent task fails with the underlying cause.

@@ -186,7 +186,7 @@ class _ByteLedger:
             fut = entry[0] if isinstance(entry, tuple) else entry
             if (
                 fut is not None
-                and fut.event.is_set()
+                and fut.done()
                 and not fut.daemon_fallback
                 and fut.results
             ):
