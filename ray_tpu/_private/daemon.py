@@ -4309,6 +4309,41 @@ class NodeDaemon:
         )
 
     # ------------------------------------------------------------------
+    def kill_worker_tree(self) -> None:
+        """SIGKILL every worker process this daemon spawned, plus its
+        fork-server, with only a brief bounded reap. Safe to call from
+        any state — including a partially-wedged runtime: a 7000-worker
+        teardown must not depend on the driver's shutdown path
+        completing (a saturated 1-core box once wedged there with the
+        whole worker tree pinning the pid table). Kills go through the
+        proc HANDLES (Popen no-ops on already-reaped children;
+        ForkedProc compares /proc start times), never raw recorded
+        pids — a recycled pid must not take down a stranger."""
+        self._shutdown = True
+        procs = list(self._worker_procs)
+        for proc in procs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        # Best-effort non-blocking reap so the killed children release
+        # their pid-table slots even if the graceful shutdown path
+        # never runs (ForkedProc children are the fork-server's to
+        # reap — closing it below reparents them to init).
+        deadline = time.monotonic() + 1.0
+        for proc in procs:
+            if time.monotonic() > deadline:
+                break
+            try:
+                proc.poll()
+            except Exception:
+                pass
+        if self._fork_server is not None:
+            try:
+                self._fork_server.close()
+            except Exception:
+                pass
+
     def shutdown(self) -> None:
         self._shutdown = True
         # Stop the heartbeat/reaper thread before closing the store:

@@ -72,9 +72,15 @@ class Session:
         except Exception:
             pass
         set_global_worker(None)
-        if self.worker is not None:
-            self.worker.shutdown()
+        # Worker teardown can fail under extreme conditions (observed:
+        # thread creation raising on a pid-exhausted host) — the
+        # daemon, which owns the spawned worker TREE, must still be
+        # torn down or orphaned workers outlive the session.
+        try:
+            if self.worker is not None:
+                self.worker.shutdown()
+        finally:
             self.worker = None
-        if self.daemon is not None:
-            self.daemon.shutdown()
-            self.daemon = None
+            if self.daemon is not None:
+                daemon, self.daemon = self.daemon, None
+                daemon.shutdown()

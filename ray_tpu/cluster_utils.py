@@ -133,6 +133,19 @@ class Cluster:
         return self.head
 
     def shutdown(self) -> None:
+        # Phase 1: SIGKILL every daemon's worker tree up front, no
+        # waits. With thousands of live workers on a small host the
+        # graceful per-daemon path can take longer than the processes
+        # deserve — and if anything earlier in teardown wedges, the
+        # orphaned tree pins the pid table (observed: a 7k-worker
+        # bench run saturating pid_max for good).
+        for node in [*self.nodes, self.head]:
+            if node is None:
+                continue
+            try:
+                node.kill_worker_tree()
+            except Exception:
+                pass
         for node in self.nodes:
             try:
                 node.shutdown()

@@ -11,7 +11,10 @@ handed to the ingress __call__ is a small Request object
 (method/path/query/headers/body/json). Route changes arrive by
 controller long-poll push (reference: long_poll.py), and generator
 ingresses stream out as chunked transfer-encoding — token N is on the
-wire while the replica computes token N+1.
+wire while the replica computes token N+1. Admission control bounds
+in-flight requests (immediate 503 + Retry-After past the cap) and
+live connections (raw 503 before a handler thread spawns);
+/-/healthz reports both shed counters.
 """
 
 from __future__ import annotations

@@ -378,8 +378,17 @@ def case_actors_10k_16_daemons() -> dict:
             os._exit(0)
         return result
     finally:
-        rt.shutdown()
-        cluster.shutdown()
+        # Worker-tree SIGKILL first and unconditionally: if
+        # rt.shutdown() wedges (observed once under a saturated pid
+        # table: thread creation fails mid-teardown), the orphaned 7k
+        # workers must not outlive this process.
+        try:
+            cluster.shutdown()
+        finally:
+            try:
+                rt.shutdown()
+            except Exception:
+                pass
 
 
 def case_args_10k_one_task() -> dict:
