@@ -4358,10 +4358,27 @@ class NodeDaemon:
                 proc.kill()
             except ProcessLookupError:
                 pass
+        # Bounded TOTAL wait, not per-proc: a per-proc 2s timeout sums
+        # to hours across a 7k-worker pool on a loaded box (each stale
+        # handle that looks alive burns its full slice); the kill
+        # above already guarantees death.
+        wait_deadline = time.monotonic() + 10.0
+        for proc in self._worker_procs:
+            remaining = wait_deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                proc.wait(timeout=min(2.0, remaining))
+            except subprocess.TimeoutExpired:
+                pass
+        # Whatever the deadline cut off still gets a non-blocking reap:
+        # SIGKILLed-but-unwaited Popen children of this (long-lived,
+        # in-process) daemon host would otherwise sit as zombies
+        # pinning pid-table slots.
         for proc in self._worker_procs:
             try:
-                proc.wait(timeout=2)
-            except subprocess.TimeoutExpired:
+                proc.poll()
+            except Exception:
                 pass
         if self._fork_server is not None:
             self._fork_server.close()
