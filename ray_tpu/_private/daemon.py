@@ -313,6 +313,9 @@ class NodeDaemon:
         # (reference: metrics agent aggregation, _private/metrics_agent
         # .py; serving role of the OpenCensus registry).
         self._metrics_table: Dict[str, dict] = {}
+        #: Standing autoscaler capacity target (head only; sdk
+        #: request_resources — REPLACE semantics, cleared by []).
+        self._resource_requests: List[dict] = []
         # Placement groups: head-side registry + node-side reserved
         # bundles ((pg_id, index) -> {"resources", "committed"}).
         self.pgs: Dict[bytes, PGEntry] = {}
@@ -366,6 +369,7 @@ class NodeDaemon:
             "list_actors",
             "list_objects",
             "cluster_load",
+            "request_resources",
             "metrics_record",
             "metrics_summary",
             "event_stats",
@@ -4083,7 +4087,25 @@ class NodeDaemon:
             "infeasible": infeasible,
             "pending_placement_groups": pending_pgs,
             "nodes": nodes,
+            "resource_requests": self._resource_requests,
         }
+
+    def _h_request_resources(self, conn, msg):
+        """Standing autoscaler target (reference:
+        ray.autoscaler.sdk.request_resources /
+        GcsAutoscalerStateManager::HandleRequestClusterResource
+        Constraint): REPLACE semantics — the latest call's bundles are
+        the whole target; an empty list clears it. Persisted only in
+        head memory: a restarted head forgets the hint, exactly like
+        the reference."""
+        if not self.is_head:
+            return self.head.call(
+                "request_resources", bundles=msg["bundles"]
+            )
+        self._resource_requests = [
+            dict(b) for b in msg["bundles"] if b
+        ]
+        return {"count": len(self._resource_requests)}
 
     # ------------------------------------------------------------------
     # OOM defense (reference: MemoryMonitor + worker killing policies)

@@ -243,6 +243,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "list_actors": {},
     "list_objects": {"?limit": int},
     "cluster_load": {},
+    "request_resources": {"bundles": list},
     "metrics_record": {"records": list},
     "metrics_summary": {},
     "event_stats": {},

@@ -3,6 +3,7 @@
 from .autoscaler import Monitor, NodeTypeConfig, StandardAutoscaler
 from .cluster import AutoscalingCluster, TpuAutoscalingCluster
 from .node_provider import FakeMultiNodeProvider, NodeProvider
+from .sdk import request_resources
 
 __all__ = [
     "StandardAutoscaler",
@@ -12,4 +13,5 @@ __all__ = [
     "FakeMultiNodeProvider",
     "AutoscalingCluster",
     "TpuAutoscalingCluster",
+    "request_resources",
 ]

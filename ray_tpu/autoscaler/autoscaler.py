@@ -136,11 +136,18 @@ class StandardAutoscaler:
                         for _ in range(max(1, cfg.slice_hosts))
                     )
 
-        # min_workers floor.
+        # min_workers floor. Floor-booked nodes contribute capacity to
+        # the pool so demand packed later (requests, tasks) does not
+        # double-launch what the floor already covers.
         to_launch: Dict[str, int] = {}
         for name, cfg in self.node_types.items():
             if counts.get(name, 0) < cfg.min_workers:
-                to_launch[name] = cfg.min_workers - counts.get(name, 0)
+                short = cfg.min_workers - counts.get(name, 0)
+                to_launch[name] = short
+                pool.extend(
+                    dict(cfg.resources)
+                    for _ in range(short * max(1, cfg.slice_hosts))
+                )
 
         def _type_room(name: str) -> int:
             cfg = self.node_types[name]
@@ -181,6 +188,34 @@ class StandardAutoscaler:
                 pool.extend(fresh)
                 return fresh
             return None
+
+        # Explicit resource requests (reference: autoscaler sdk
+        # request_resources): a standing TARGET the cluster must be
+        # able to hold. Satisfied bundles HOLD their nodes against
+        # idle scale-down — terminating one would immediately recreate
+        # the demand and flap the node back up.
+        held_nodes: set = set()
+        unsatisfied_requests = 0
+        daemon_count = len(load["nodes"])
+        requests = load.get("resource_requests") or []
+        for request in requests:
+            placed = False
+            for idx, capacity in enumerate(pool):
+                if _fits(request, capacity):
+                    _consume(capacity, request)
+                    if idx < daemon_count:
+                        held_nodes.add(load["nodes"][idx]["node_id"])
+                    placed = True
+                    break
+            if not placed:
+                added = _launch_for(request)
+                if added:
+                    _consume(added[0], request)
+                else:
+                    # No node type fits (or max_workers reached): the
+                    # standing target cannot be met — surface it
+                    # rather than silently dropping it every tick.
+                    unsatisfied_requests += 1
 
         # Bin-pack flat demand (reference: resource_demand_scheduler).
         for request in flat:
@@ -250,6 +285,10 @@ class StandardAutoscaler:
             daemons = self._daemons_of(p, load)
             if not daemons:
                 continue  # still launching
+            if any(n["node_id"] in held_nodes for n in daemons):
+                # Capacity pinned by an explicit resource request.
+                self._last_busy[p] = now
+                continue
             busy = any(
                 node["queued"] > 0
                 or any(
@@ -274,7 +313,10 @@ class StandardAutoscaler:
                 counts[node_type] = type_count - 1
                 terminated.append(p)
         return {
-            "demand": len(flat) + sum(len(g) for g in gangs),
+            "demand": len(flat)
+            + sum(len(g) for g in gangs)
+            + len(requests),
+            "unsatisfied_requests": unsatisfied_requests,
             "launched": launched,
             "terminated": terminated,
         }

@@ -1,0 +1,57 @@
+"""Programmatic autoscaler API.
+
+Reference: python/ray/autoscaler/sdk/__init__.py request_resources —
+applications command a standing capacity target ("make sure the
+cluster can hold this much") independent of any queued work; the
+autoscaler scales up to satisfy it and holds the satisfying nodes
+against idle scale-down until the target is replaced.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(
+    num_cpus: Optional[int] = None,
+    bundles: Optional[List[Dict[str, float]]] = None,
+) -> int:
+    """Set (REPLACE) the cluster's standing resource target.
+
+    `num_cpus=N` expands to N one-CPU bundles (the reference's
+    semantics — aggregate CPU capacity, placeable anywhere).
+    `bundles` is a list of resource dicts that must each fit on some
+    node. Calling with neither (or `bundles=[]`) clears the target,
+    letting idle nodes scale down again. Returns the number of
+    bundles now standing.
+    """
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    if worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    out: List[Dict[str, float]] = []
+    if num_cpus:
+        if int(num_cpus) < 0:
+            raise ValueError(f"num_cpus must be >= 0, got {num_cpus}")
+        out.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    for bundle in bundles or ():
+        # Same contract as placement_group(): non-empty
+        # {resource: amount > 0} dicts — a zero/negative amount would
+        # trivially "fit" every node and pin it against scale-down
+        # forever.
+        if not isinstance(bundle, dict) or not bundle:
+            raise ValueError(
+                f"bundles must be non-empty dicts, got {bundle!r}"
+            )
+        clean = {}
+        for name, amount in bundle.items():
+            amount = float(amount)
+            if amount <= 0:
+                raise ValueError(
+                    f"bundle amounts must be > 0, got "
+                    f"{name}={amount} in {bundle!r}"
+                )
+            clean[name] = amount
+        out.append(clean)
+    return worker.call("request_resources", bundles=out)["count"]

@@ -243,3 +243,34 @@ def test_min_workers_floor():
         rt.shutdown()
     finally:
         cluster.shutdown()
+
+
+def test_request_resources_scales_up_holds_then_releases(scaling_cluster):
+    """Programmatic capacity target (reference: autoscaler sdk
+    request_resources): scaling happens WITHOUT any queued work, the
+    satisfying nodes are held against idle scale-down while the
+    target stands, and clearing the target releases them."""
+    rt, cluster = scaling_cluster
+    from ray_tpu.autoscaler import request_resources
+
+    assert cluster.num_workers() == 0
+    # 4 one-CPU bundles: head holds 1, so at least 2 x 2-CPU workers
+    # must come up — with zero tasks submitted.
+    count = request_resources(num_cpus=4)
+    assert count == 4
+    deadline = time.time() + 60
+    while time.time() < deadline and cluster.num_workers() < 2:
+        time.sleep(0.3)
+    assert cluster.num_workers() >= 2
+
+    # Held: idle_timeout_s=2.0 must NOT scale these down while the
+    # target stands.
+    time.sleep(5.0)
+    assert cluster.num_workers() >= 2
+
+    # Clearing the target releases the nodes.
+    assert request_resources(bundles=[]) == 0
+    deadline = time.time() + 30
+    while time.time() < deadline and cluster.num_workers() > 0:
+        time.sleep(0.3)
+    assert cluster.num_workers() == 0
