@@ -6,6 +6,7 @@ from .dag_node import (
     ClassMethodNode,
     DAGNode,
     FunctionNode,
+    InputAttributeNode,
     InputNode,
     MultiOutputNode,
     experimental_compile,
@@ -14,6 +15,7 @@ from .dag_node import (
 __all__ = [
     "DAGNode",
     "InputNode",
+    "InputAttributeNode",
     "MultiOutputNode",
     "FunctionNode",
     "ClassMethodNode",

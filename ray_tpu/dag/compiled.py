@@ -25,9 +25,13 @@ from .tcp_channel import TcpChannel
 from .dag_node import (
     ClassMethodNode,
     DAGNode,
+    InputAttributeNode,
     InputNode,
     MultiOutputNode,
 )
+
+#: Sentinel key for "the whole input value" (no projection).
+_WHOLE = object()
 
 DAG_LOOP_METHOD = "__rt_dag_loop__"
 
@@ -112,7 +116,8 @@ class CompiledDAG:
         self._next_read_seq = 0
         self._results: Dict[int, Any] = {}
         self._torn_down = False
-        self._input_channels: List[ShmChannel] = []
+        #: [(channel, projection key | _WHOLE)] in bind order.
+        self._input_channels: List[tuple] = []
         self._output_channels: List[ShmChannel] = []
         self._all_channels: List[ShmChannel] = []
         self._loop_refs = []
@@ -135,7 +140,9 @@ class CompiledDAG:
         actor_nodes: List[ClassMethodNode] = []
         seen_actors = set()
         for node in order:
-            if isinstance(node, (InputNode, MultiOutputNode)):
+            if isinstance(
+                node, (InputNode, InputAttributeNode, MultiOutputNode)
+            ):
                 continue
             if not isinstance(node, ClassMethodNode):
                 raise TypeError(
@@ -169,9 +176,14 @@ class CompiledDAG:
             descs: List[Tuple[str, Any]] = []
             node_placement = placement[node.actor_handle.actor_id.binary()]
             for arg in node._bound_args:
-                if isinstance(arg, InputNode):
+                if isinstance(arg, (InputNode, InputAttributeNode)):
                     chan = self._new_channel(driver_node, node_placement)
-                    self._input_channels.append(chan)
+                    key = (
+                        arg.key
+                        if isinstance(arg, InputAttributeNode)
+                        else _WHOLE
+                    )
+                    self._input_channels.append((chan, key))
                     descs.append(("chan", chan))
                 elif isinstance(arg, ClassMethodNode):
                     src = placement[arg.actor_handle.actor_id.binary()]
@@ -266,14 +278,21 @@ class CompiledDAG:
         # across concurrent executes) with a bounded put, so a stalled
         # or dead stage surfaces as ChannelTimeoutError instead of
         # blocking the state lock — which teardown() also needs.
+        # Compute every projection BEFORE any channel write: a bad
+        # input (missing key) must fail the whole execute, not leave
+        # some stages fed and others starved.
+        payloads = [
+            (chan, value if key is _WHOLE else value[key])
+            for chan, key in self._input_channels
+        ]
         with self._submit_mutex:
             with self._lock:
                 if self._torn_down:
                     raise RuntimeError("compiled DAG was torn down")
                 seq = self._next_seq
                 self._next_seq += 1
-            for chan in self._input_channels:
-                chan.put(("v", value), timeout=timeout)
+            for chan, payload in payloads:
+                chan.put(("v", payload), timeout=timeout)
         return CompiledDAGRef(self, seq)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
@@ -330,7 +349,7 @@ class CompiledDAG:
         # Stop tokens go through the submit mutex like any execute
         # (bounded puts: a wedged stage can't hang teardown).
         with self._submit_mutex:
-            for chan in self._input_channels:
+            for chan, _key in self._input_channels:
                 try:
                     chan.put(("s", None), timeout=5)
                 except Exception:

@@ -99,6 +99,38 @@ class InputNode(DAGNode):
             )
         return input_values[0]
 
+    def __getitem__(self, key) -> "InputAttributeNode":
+        """`inp[0]` / `inp["x"]` — bind a projection of the runtime
+        input (reference: python/ray/dag/input_node.py
+        InputAttributeNode), so one execute() value fans different
+        fields out to different nodes."""
+        return InputAttributeNode(self, key)
+
+    def __iter__(self):
+        # __getitem__ would otherwise make this "iterable" via the
+        # legacy protocol — an infinite stream of projection nodes
+        # (`for x in inp:` / `a, b = inp` would hang or mislead).
+        raise TypeError(
+            "InputNode is not iterable; bind explicit projections "
+            "(inp[0], inp[1], ...) instead"
+        )
+
+
+class InputAttributeNode(DAGNode):
+    """A key/index projection of the InputNode's runtime value."""
+
+    def __init__(self, input_node: InputNode, key):
+        super().__init__((input_node,), {})
+        self.key = key
+
+    @property
+    def input_node(self) -> InputNode:
+        return self._bound_args[0]
+
+    def _apply(self, args, kwargs, input_values):
+        # args[0] is the InputNode's applied value (the raw input).
+        return args[0][self.key]
+
 
 class FunctionNode(DAGNode):
     """`remote_fn.bind(...)` — a task invocation."""

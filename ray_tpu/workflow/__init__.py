@@ -26,7 +26,12 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-from ..dag.dag_node import DAGNode, FunctionNode, InputNode
+from ..dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+)
 
 _DEFAULT_ROOT = os.path.join(
     tempfile.gettempdir(), "rt_workflows"
@@ -230,6 +235,14 @@ def _execute(
         step_id = frame.ids[id(node)]
         if isinstance(node, InputNode):
             frame.cache[id(node)] = frame.input_value
+            frame.idx += 1
+            continue
+        if isinstance(node, InputAttributeNode):
+            # inp["key"] projection — the InputNode child resolved in
+            # an earlier topological slot.
+            frame.cache[id(node)] = frame.cache[id(node.input_node)][
+                node.key
+            ]
             frame.idx += 1
             continue
         if storage.has_step(step_id):

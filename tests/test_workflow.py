@@ -85,3 +85,27 @@ def test_workflow_failure_and_resume(rt_session, tmp_path):
     )
     # Resuming a finished workflow returns the stored output.
     assert workflow.resume("wf2", storage=str(tmp_path)) == 200
+
+
+def test_workflow_with_input_projection(rt_session, tmp_path):
+    """inp["key"] projections work in the workflow execution mode too
+    (the third mode over the same DAG types)."""
+    rt = rt_session
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    @rt.remote
+    def double(x):
+        return x * 2
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp["a"]), inp["b"])
+    out = workflow.run(
+        dag, input_value={"a": 4, "b": 1}, workflow_id="proj",
+        storage=str(tmp_path),
+    )
+    assert out == 9
