@@ -215,7 +215,7 @@ def create_actor(ac: ActorClass, args: tuple, kwargs: dict) -> ActorHandle:
         _flatten_args(args, kwargs),
         class_name=ac.underlying.__name__,
         name=opts.get("name"),
-        namespace=opts.get("namespace", "default"),
+        namespace=opts.get("namespace") or worker.namespace,
         resources=resources,
         max_restarts=opts.get("max_restarts", 0),
         max_concurrency=int(opts.get("max_concurrency", 1)),

@@ -4007,6 +4007,7 @@ class NodeDaemon:
                     {
                         "actor_id": rt.info.actor_id.hex(),
                         "name": rt.info.name,
+                        "namespace": rt.info.namespace,
                         "state": rt.info.state,
                         "class_name": rt.info.class_name,
                         "num_restarts": rt.info.num_restarts,

@@ -109,6 +109,11 @@ _worker_generation = itertools.count()
 class CoreWorker:
     def __init__(self, socket_path: str, role: str = "driver"):
         self.role = role
+        #: Default namespace for named-actor APIs in THIS process.
+        #: The driver's is set from rt.init(namespace=...); worker
+        #: processes keep "default" — in-task named-actor calls that
+        #: need a session namespace must pass namespace= explicitly.
+        self.namespace = "default"
         # Unique per-process token for session-scoped caches (unlike
         # id(), never reused after this worker is collected).
         self.generation = next(_worker_generation)

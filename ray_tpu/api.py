@@ -50,6 +50,12 @@ def init(
         system_config=_system_config,
         address=address,
     )
+    # Session-scoped namespace: the default for named-actor creation,
+    # get_actor, and list_named_actors in THIS (driver) process
+    # (reference: ray.init(namespace)). Worker-side calls inside
+    # tasks/actors default to "default" — pass namespace= explicitly
+    # there.
+    _session.worker.namespace = namespace
     return _session
 
 
@@ -133,7 +139,11 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     _worker().call("cancel_task", task_id=ref.id().task_id().binary())
 
 
-def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+def get_actor(
+    name: str, namespace: Optional[str] = None
+) -> ActorHandle:
+    if namespace is None:
+        namespace = _worker().namespace
     reply = _worker().call(
         "get_named_actor", name=name, namespace=namespace
     )

@@ -57,7 +57,9 @@ def test_scales_up_for_placement_group(scaling_cluster):
     pg = placement_group(
         [{"CPU": 2.0}, {"CPU": 2.0}], strategy="STRICT_SPREAD"
     )
-    assert pg.wait(60)
+    # Generous: worker spawn + 2PC on a 1-core box mid-suite can
+    # take minutes under load (flaked at 60s in a full-suite run).
+    assert pg.wait(150)
     assert cluster.num_workers() >= 2
 
 
@@ -154,7 +156,7 @@ def test_slice_pg_scales_up_one_tpu_node_then_down():
         assert cluster.num_slices() == 0
 
         pg = slice_placement_group("v5e-16")
-        assert pg.wait(90), "slice gang never scheduled"
+        assert pg.wait(180), "slice gang never scheduled"
 
         # Slice granularity: the 4-bundle STRICT_SPREAD gang launched
         # exactly ONE provider node (not 4), with 4 host daemons.

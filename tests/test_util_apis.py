@@ -128,3 +128,61 @@ def test_queue_full_and_empty(rt_session):
     with pytest.raises(Empty):
         queue.get(block=False)
     queue.shutdown()
+
+
+def test_list_named_actors(rt_session):
+    """reference: ray.util.list_named_actors — live named actors,
+    optionally across namespaces."""
+    rt = rt_session
+    from ray_tpu.util import list_named_actors
+
+    @rt.remote
+    class N:
+        def ping(self):
+            return 1
+
+    a = N.options(name="walter").remote()
+    b = N.options(name="jesse", namespace="abq").remote()
+    rt.get([a.ping.remote(), b.ping.remote()], timeout=30)
+
+    names = list_named_actors()
+    assert "walter" in names and "jesse" not in names
+    rows = list_named_actors(all_namespaces=True)
+    assert {"name": "jesse", "namespace": "abq"} in rows
+    assert any(r["name"] == "walter" for r in rows)
+
+    rt.kill(a)
+    import time as _t
+
+    deadline = _t.time() + 15
+    while _t.time() < deadline and "walter" in list_named_actors():
+        _t.sleep(0.2)
+    assert "walter" not in list_named_actors()
+
+
+def test_session_namespace_scopes_named_actors():
+    """rt.init(namespace=...) scopes named-actor creation, get_actor,
+    and list_named_actors (reference: ray.init(namespace))."""
+    import ray_tpu as rt
+    from ray_tpu.util import list_named_actors
+
+    rt.init(num_cpus=2, namespace="abq")
+    try:
+
+        @rt.remote
+        class N:
+            def ping(self):
+                return 1
+
+        a = N.options(name="gus").remote()
+        rt.get(a.ping.remote(), timeout=30)
+        # Scoped listing sees it; explicit default-namespace miss.
+        assert "gus" in list_named_actors()
+        h = rt.get_actor("gus")  # session namespace is the default
+        assert rt.get(h.ping.remote(), timeout=20) == 1
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            rt.get_actor("gus", namespace="default")
+    finally:
+        rt.shutdown()
