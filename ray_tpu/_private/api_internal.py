@@ -44,9 +44,23 @@ _RESOURCE_INTERN: Dict[tuple, dict] = {}
 
 
 def _task_resources(options: Dict[str, Any], default_cpu: float) -> dict:
-    resources = dict(options.get("resources") or {})
     num_cpus = options.get("num_cpus")
     num_tpus = options.get("num_tpus")
+    if (
+        not options.get("resources")
+        and num_cpus is None
+        and not num_tpus
+    ):
+        # Fast path for the overwhelmingly common default shape: no
+        # per-task dict build, no sort key (the submit hot path runs
+        # this once per task at 15k+/s).
+        key = ("default", default_cpu)
+        cached = _RESOURCE_INTERN.get(key)
+        if cached is None:
+            cached = {"CPU": float(default_cpu)} if default_cpu else {}
+            _RESOURCE_INTERN[key] = cached
+        return cached
+    resources = dict(options.get("resources") or {})
     resources["CPU"] = float(default_cpu if num_cpus is None else num_cpus)
     if num_tpus:
         resources["TPU"] = float(num_tpus)
