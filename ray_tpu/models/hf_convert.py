@@ -1,21 +1,24 @@
 """HuggingFace checkpoint conversion (Llama + Qwen2 + Mistral +
-Gemma families).
+Gemma + Phi-3 families).
 
 The integration-parity role of the reference's framework adapters
 (reference: python/ray/train/huggingface/ — Ray Train wraps HF
 Trainer/accelerate; SURVEY §2.3 Train-integrations row): here the
 integration is TPU-first — convert an HF `LlamaForCausalLM`,
-`Qwen2ForCausalLM` or `MistralForCausalLM` state dict into this
-framework's stacked-scan parameter pytree and run it on the
-JAX/Pallas stack. The three share a skeleton (RMSNorm, SwiGLU,
-rotate-half RoPE, GQA); Qwen2 adds QKV projection biases
+`Qwen2ForCausalLM`, `MistralForCausalLM`, `GemmaForCausalLM` or
+`Phi3ForCausalLM` state dict into this framework's stacked-scan
+parameter pytree and run it on the JAX/Pallas stack. All five share
+a skeleton (RMSNorm, gated MLP, rotate-half RoPE, GQA); Qwen2 adds
+QKV projection biases
 (cfg.attn_bias); Mistral converts only with its sliding window
 disabled (v0.3+ checkpoints — an active window would change
 long-context numerics); Gemma-1 swaps in a GeGLU gate, (1+w)
 RMSNorms, a sqrt(dim) embedding scale and a head_dim decoupled from
-dim/n_heads (gemma-2's soft-capping stays loudly unsupported).
-tests/test_hf_parity.py proves numerical parity of the full forward
-(logits) against transformers' reference implementation for all four.
+dim/n_heads (gemma-2's soft-capping stays loudly unsupported);
+Phi-3 fuses qkv_proj and gate_up_proj, which the converter splits by
+output-row ranges. tests/test_hf_parity.py proves numerical parity of
+the full forward (logits) against transformers' reference
+implementation for all five.
 
 Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
 so activations right-multiply):
@@ -78,25 +81,37 @@ def config_from_hf(hf_config) -> LlamaConfig:
                 "token"
             )
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "qwen2", "mistral", "gemma"):
+    if model_type not in ("llama", "qwen2", "mistral", "gemma", "phi3"):
         raise NotImplementedError(
             f"model_type={model_type!r}: only the llama, qwen2, "
-            "mistral and gemma families convert; anything else would "
-            "need its own numerics audit (gemma2's logit soft-capping "
-            "and alternating sliding windows are NOT implemented — "
-            "converting one would silently change its numerics)"
+            "mistral, gemma and phi3 families convert; anything else "
+            "would need its own numerics audit (gemma2's logit "
+            "soft-capping and alternating sliding windows are NOT "
+            "implemented — converting one would silently change its "
+            "numerics)"
         )
     # Qwen2 gates SWA behind use_sliding_window (default False);
-    # Mistral enables it whenever sliding_window is set (v0.1 ships
-    # 4096; v0.3 ships null). Either way an *active* window changes
+    # Mistral/Phi-3 enable it whenever sliding_window is set AND
+    # smaller than the context (Phi-3.5 ships window >= context — a
+    # no-op window that must not block conversion; Mistral v0.1's
+    # 4096 < 32768 is active and must). An *active* window changes
     # long-context numerics this model doesn't implement.
+    window = getattr(hf_config, "sliding_window", None)
+    max_pos = getattr(hf_config, "max_position_embeddings", 4096)
     if getattr(hf_config, "use_sliding_window", False) or (
-        model_type == "mistral"
-        and getattr(hf_config, "sliding_window", None) is not None
+        model_type in ("mistral", "phi3")
+        and window is not None
+        and window < max_pos
     ):
         raise NotImplementedError(
-            "sliding-window attention is not implemented; converting "
-            "would silently change long-context numerics"
+            "active sliding-window attention is not implemented; "
+            "converting would silently change long-context numerics"
+        )
+    if float(getattr(hf_config, "partial_rotary_factor", 1.0)) != 1.0:
+        raise NotImplementedError(
+            "partial_rotary_factor != 1.0 (Phi-4-style partial RoPE) "
+            "is not implemented; converting would mis-position every "
+            "token"
         )
     # Qwen2 carries QKV biases (and only those). Llama's rare
     # attention_bias=True variant ALSO biases o_proj — a layout this
@@ -197,21 +212,61 @@ def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
             mats.append(w.T if transpose else w)
         return jnp.asarray(np.stack(mats), dtype=cfg.dtype)
 
-    layers = {
-        "wq": stack("self_attn.q_proj.weight"),
-        "wk": stack("self_attn.k_proj.weight"),
-        "wv": stack("self_attn.v_proj.weight"),
+    def split_fused(name: str, boundaries):
+        """Split a FUSED projection (Phi-3 qkv_proj / gate_up_proj)
+        along its OUTPUT axis at `boundaries`, via the same stack()
+        loader ([L, in, out] after transpose). The boundaries must
+        cover the matrix exactly — silently dropped rows would
+        convert into a numerically wrong model with every shape
+        self-consistent."""
+        whole = stack(name)
+        if whole.shape[-1] != boundaries[-1]:
+            raise ValueError(
+                f"{name}: fused width {whole.shape[-1]} != expected "
+                f"{boundaries[-1]} from the config's head/intermediate "
+                "geometry — refusing to convert a partial split"
+            )
+        out, lo = [], 0
+        for hi in boundaries:
+            out.append(whole[..., lo:hi])
+            lo = hi
+        return out
+
+    hd = cfg.head_dim
+    fused = layer_key(0, "self_attn.qkv_proj.weight") in state_dict
+    if fused:  # Phi-3 layout
+        q_rows = cfg.n_heads * hd
+        kv_rows = cfg.n_kv_heads * hd
+        wq, wk, wv = split_fused(
+            "self_attn.qkv_proj.weight",
+            [q_rows, q_rows + kv_rows, q_rows + 2 * kv_rows],
+        )
+        # gate_up_proj fuses [gate; up]; our forward computes
+        # glu(h @ w1, h @ w3) with the gate in w3.
+        w3, w1 = split_fused(
+            "mlp.gate_up_proj.weight",
+            [cfg.intermediate, 2 * cfg.intermediate],
+        )
+        layers = {"wq": wq, "wk": wk, "wv": wv, "w3": w3, "w1": w1}
+    else:
+        layers = {
+            "wq": stack("self_attn.q_proj.weight"),
+            "wk": stack("self_attn.k_proj.weight"),
+            "wv": stack("self_attn.v_proj.weight"),
+            # Our swiglu(x, gate) gates its SECOND argument; the
+            # forward computes swiglu(h @ w1, h @ w3), so gate_proj
+            # lands in w3.
+            "w3": stack("mlp.gate_proj.weight"),
+            "w1": stack("mlp.up_proj.weight"),
+        }
+    layers.update({
         "wo": stack("self_attn.o_proj.weight"),
-        # Our swiglu(x, gate) gates its SECOND argument; the forward
-        # computes swiglu(h @ w1, h @ w3), so gate_proj lands in w3.
-        "w3": stack("mlp.gate_proj.weight"),
-        "w1": stack("mlp.up_proj.weight"),
         "w2": stack("mlp.down_proj.weight"),
         "attn_norm": stack("input_layernorm.weight", transpose=False),
         "mlp_norm": stack(
             "post_attention_layernorm.weight", transpose=False
         ),
-    }
+    })
     if cfg.attn_bias:  # Qwen2-family QKV biases (1-D: no transpose)
         layers.update({
             "bq": stack("self_attn.q_proj.bias", transpose=False),
