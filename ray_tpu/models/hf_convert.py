@@ -1,24 +1,25 @@
-"""HuggingFace checkpoint conversion (Llama + Qwen2 + Mistral +
-Gemma + Phi-3 families).
+"""HuggingFace checkpoint conversion (Llama + Qwen2 + Qwen3 +
+Mistral + Gemma + Phi-3 families).
 
 The integration-parity role of the reference's framework adapters
 (reference: python/ray/train/huggingface/ — Ray Train wraps HF
 Trainer/accelerate; SURVEY §2.3 Train-integrations row): here the
 integration is TPU-first — convert an HF `LlamaForCausalLM`,
-`Qwen2ForCausalLM`, `MistralForCausalLM`, `GemmaForCausalLM` or
-`Phi3ForCausalLM` state dict into this framework's stacked-scan
-parameter pytree and run it on the JAX/Pallas stack. All five share
-a skeleton (RMSNorm, gated MLP, rotate-half RoPE, GQA); Qwen2 adds
-QKV projection biases
+`Qwen2ForCausalLM`, `Qwen3ForCausalLM`, `MistralForCausalLM`,
+`GemmaForCausalLM` or `Phi3ForCausalLM` state dict into this
+framework's stacked-scan parameter pytree and run it on the
+JAX/Pallas stack. All six share a skeleton (RMSNorm, gated MLP,
+rotate-half RoPE, GQA); Qwen2 adds QKV projection biases
 (cfg.attn_bias); Mistral converts only with its sliding window
 disabled (v0.3+ checkpoints — an active window would change
 long-context numerics); Gemma-1 swaps in a GeGLU gate, (1+w)
 RMSNorms, a sqrt(dim) embedding scale and a head_dim decoupled from
 dim/n_heads (gemma-2's soft-capping stays loudly unsupported);
 Phi-3 fuses qkv_proj and gate_up_proj, which the converter splits by
-output-row ranges. tests/test_hf_parity.py proves numerical parity of
-the full forward (logits) against transformers' reference
-implementation for all five.
+output-row ranges; Qwen3 adds per-head RMSNorm on q and k before
+RoPE (cfg.qk_norm) with a decoupled head_dim.
+tests/test_hf_parity.py proves numerical parity of the full forward
+(logits) against transformers' reference implementation for all six.
 
 Weight-layout notes (torch Linear stores [out, in]; we store [in, out]
 so activations right-multiply):
@@ -81,14 +82,16 @@ def config_from_hf(hf_config) -> LlamaConfig:
                 "token"
             )
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "qwen2", "mistral", "gemma", "phi3"):
+    if model_type not in (
+        "llama", "qwen2", "mistral", "gemma", "phi3", "qwen3"
+    ):
         raise NotImplementedError(
             f"model_type={model_type!r}: only the llama, qwen2, "
-            "mistral, gemma and phi3 families convert; anything else "
-            "would need its own numerics audit (gemma2's logit "
-            "soft-capping and alternating sliding windows are NOT "
-            "implemented — converting one would silently change its "
-            "numerics)"
+            "qwen3, mistral, gemma and phi3 families convert; "
+            "anything else would need its own numerics audit "
+            "(gemma2's logit soft-capping and alternating sliding "
+            "windows are NOT implemented — converting one would "
+            "silently change its numerics)"
         )
     # Qwen2 gates SWA behind use_sliding_window (default False);
     # Mistral/Phi-3 enable it whenever sliding_window is set AND
@@ -160,6 +163,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
         head_dim = 0  # derived — keep the config canonical
     return LlamaConfig(
         attn_bias=model_type == "qwen2",
+        qk_norm=model_type == "qwen3",
         custom_head_dim=head_dim,
         act=act,
         norm_offset=model_type == "gemma",
@@ -272,6 +276,11 @@ def convert_hf_llama(state_dict: Dict[str, Any], cfg: LlamaConfig):
             "bq": stack("self_attn.q_proj.bias", transpose=False),
             "bk": stack("self_attn.k_proj.bias", transpose=False),
             "bv": stack("self_attn.v_proj.bias", transpose=False),
+        })
+    if cfg.qk_norm:  # Qwen3 per-head q/k RMSNorm weights
+        layers.update({
+            "q_norm": stack("self_attn.q_norm.weight", transpose=False),
+            "k_norm": stack("self_attn.k_norm.weight", transpose=False),
         })
     embed = _np(state_dict["model.embed_tokens.weight"])
     consumed.add("model.embed_tokens.weight")

@@ -88,6 +88,8 @@ class LlamaConfig:
     norm_offset: bool = False
     #: Multiply embedding output by sqrt(dim) (Gemma normalizer).
     embed_scale: bool = False
+    #: Per-head RMSNorm on q and k before RoPE (Qwen3 family).
+    qk_norm: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -112,6 +114,8 @@ class LlamaConfig:
             per_layer += (
                 self.n_heads + 2 * self.n_kv_heads
             ) * self.head_dim
+        if self.qk_norm:
+            per_layer += 2 * self.head_dim
         return embed * 2 + self.n_layers * per_layer + self.dim
 
     # ---- presets ----
@@ -217,6 +221,11 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
             "bk": jnp.zeros((L, cfg.n_kv_heads * hd), dt),
             "bv": jnp.zeros((L, cfg.n_kv_heads * hd), dt),
         })
+    if cfg.qk_norm:
+        layers.update({
+            "q_norm": jnp.ones((L, hd), dt),
+            "k_norm": jnp.ones((L, hd), dt),
+        })
     if cfg.moe_experts:
         E = cfg.moe_experts
         layers.update({
@@ -261,6 +270,11 @@ def param_annotations(cfg: LlamaConfig) -> Dict[str, Any]:
             "bk": annotate("layers", "kv_heads"),
             "bv": annotate("layers", "kv_heads"),
         })
+    if cfg.qk_norm:
+        layers.update({
+            "q_norm": annotate("layers", None),
+            "k_norm": annotate("layers", None),
+        })
     if cfg.moe_experts:
         layers.update({
             "router": annotate("layers", "embed", None),
@@ -294,6 +308,12 @@ def project_qkv(cfg: LlamaConfig, h, layer):
     q = q.reshape(b, t, cfg.n_heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim, BEFORE RoPE (callers
+        # apply rope to whatever this returns, matching transformers'
+        # q_norm/k_norm placement).
+        q = rms_norm(q, layer["q_norm"], eps=cfg.norm_eps)
+        k = rms_norm(k, layer["k_norm"], eps=cfg.norm_eps)
     return q, k, v
 
 
