@@ -21,7 +21,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 #: decision so they can never disagree.
 _SO_OVERRIDE = os.environ.get("RT_NATIVE_SO")
 _SO = _SO_OVERRIDE or os.path.join(_DIR, "libray_tpu_store.so")
-_build_lock = threading.Lock()
+_build_lock = threading.Lock()  # rt: noqa[RT004] — held only inside load_library(), never across fork
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

@@ -1,0 +1,244 @@
+// ThreadSanitizer exerciser for the shared-memory arena (store.cc).
+//
+// Completes the sanitizer trio the reference maintains for its C++
+// core (SURVEY §5.2): ASan/UBSan sweep the API single-threaded
+// (tests/test_sanitizers.py drives the Python binding under a
+// preloaded runtime); THIS binary hammers one arena from many
+// threads — and optionally several forked processes — under
+// -fsanitize=thread, which needs an instrumented main() (TSan does
+// not support LD_PRELOAD into an uninstrumented interpreter, so the
+// exerciser is a standalone program rather than a Python script).
+//
+// Shape: N threads x M iterations of randomized create / write /
+// seal(+pinned) / pin+read / delete / stats / reap against a small
+// arena (eviction pressure guaranteed: the oid working set exceeds
+// capacity). Payload writes happen OUTSIDE the arena mutex by design
+// — the happens-before chain create(lock) -> write -> seal(lock) ->
+// pin(lock) -> read is exactly what TSan verifies. Forked children
+// run before any thread starts (TSan restriction) and exercise the
+// PROCESS-SHARED robust mutex across address spaces.
+//
+// Build (tests/test_sanitizers.py does this on the fly; also
+// `make -C ray_tpu/_native tsan`):
+//   g++ -O1 -g -std=c++17 -fsanitize=thread \
+//       store.cc tsan_exerciser.cc -o store_tsan_exerciser -lpthread
+//
+// Usage: store_tsan_exerciser <arena-path> [threads] [iters] [forks]
+// Exits 0 and prints TSAN-SWEEP-OK when the sweep finishes with
+// consistent stats; TSan itself aborts nonzero on any race.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <pthread.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+void* rts_open(const char* path, uint64_t capacity, uint32_t num_slots,
+               int create);
+uint8_t* rts_base(void* handle);
+int64_t rts_create(void* handle, const uint8_t* oid, uint64_t size,
+                   uint8_t* evicted_out, int max_evicted, int* n_evicted);
+int rts_seal(void* handle, const uint8_t* oid);
+int64_t rts_seal_pinned(void* handle, const uint8_t* oid,
+                        uint64_t* offset_out, uint64_t* size_out);
+int64_t rts_lookup(void* handle, const uint8_t* oid, uint64_t* size_out,
+                   int sealed_only);
+int64_t rts_pin(void* handle, const uint8_t* oid, uint64_t* offset_out,
+                uint64_t* size_out);
+int rts_unpin_idx(void* handle, int32_t index);
+int rts_reap_dead_pins(void* handle);
+uint64_t rts_untracked_pins(void* handle);
+int rts_delete(void* handle, const uint8_t* oid);
+int rts_stats(void* handle, uint64_t* capacity, uint64_t* used,
+              uint64_t* num_objects);
+void rts_close(void* handle, int unlink_file, const char* path);
+}
+
+namespace {
+
+constexpr uint32_t kOidBytes = 20;
+constexpr uint64_t kCapacity = 1 << 20;  // 1 MiB: guarantees eviction
+constexpr uint32_t kSlots = 1024;
+constexpr int kOidSpace = 64;  // working set of object ids
+
+struct ThreadArgs {
+  void* handle;
+  uint8_t* heap;
+  uint64_t seed;
+  int iters;
+  long errors;  // impossible return codes (not contention outcomes)
+};
+
+void make_oid(int i, uint8_t* out) {
+  memset(out, 0, kOidBytes);
+  snprintf(reinterpret_cast<char*>(out), kOidBytes, "oid-%04d", i);
+}
+
+uint64_t next_rand(uint64_t* state) {  // splitmix64: deterministic,
+  *state += 0x9e3779b97f4a7c15ULL;     // no shared libc rand() state
+  uint64_t z = *state;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+void* hammer(void* argp) {
+  ThreadArgs* args = static_cast<ThreadArgs*>(argp);
+  uint64_t rng = args->seed;
+  uint8_t oid[kOidBytes];
+  uint8_t evicted[kOidBytes * 64];
+  volatile uint64_t sink = 0;  // keep payload reads alive
+  for (int i = 0; i < args->iters; ++i) {
+    uint64_t r = next_rand(&rng);
+    make_oid(static_cast<int>(r % kOidSpace), oid);
+    uint64_t op = (r >> 8) % 100;
+    if (op < 40) {
+      // create -> fill payload (outside the lock: the interesting
+      // part) -> seal; every third creation uses the combined
+      // seal_pinned and reads its own bytes back under the pin.
+      uint64_t size = 64 + ((r >> 16) % 4000);
+      int n_evicted = 0;
+      int64_t offset = rts_create(args->handle, oid, size, evicted, 64,
+                                  &n_evicted);
+      if (offset >= 0) {
+        memset(args->heap + offset, static_cast<int>(r & 0xff),
+               static_cast<size_t>(size));
+        if (op % 3 == 0) {
+          uint64_t poff = 0, psize = 0;
+          int64_t index =
+              rts_seal_pinned(args->handle, oid, &poff, &psize);
+          if (index >= 0) {
+            sink += args->heap[poff] + args->heap[poff + psize - 1];
+            rts_unpin_idx(args->handle, static_cast<int32_t>(index));
+          }
+        } else {
+          rts_seal(args->handle, oid);
+        }
+      } else if (offset != -2 && offset != -3) {
+        ++args->errors;  // EXISTS/FULL are expected under contention
+      }
+    } else if (op < 70) {
+      uint64_t poff = 0, psize = 0;
+      int64_t index = rts_pin(args->handle, oid, &poff, &psize);
+      if (index >= 0) {
+        // Read while pinned: first/middle/last byte of the payload.
+        sink += args->heap[poff] + args->heap[poff + psize / 2] +
+                args->heap[poff + psize - 1];
+        rts_unpin_idx(args->handle, static_cast<int32_t>(index));
+      }
+    } else if (op < 85) {
+      rts_delete(args->handle, oid);
+    } else if (op < 95) {
+      uint64_t size = 0;
+      rts_lookup(args->handle, oid, &size, 1);
+      uint64_t cap = 0, used = 0, num = 0;
+      rts_stats(args->handle, &cap, &used, &num);
+      if (used > cap) ++args->errors;
+    } else {
+      rts_reap_dead_pins(args->handle);
+      rts_untracked_pins(args->handle);
+    }
+  }
+  return nullptr;
+}
+
+// Run the threaded sweep in the current process; returns error count.
+long run_threads(void* handle, int threads, int iters, uint64_t salt) {
+  ThreadArgs* args = new ThreadArgs[threads];
+  pthread_t* tids = new pthread_t[threads];
+  uint8_t* heap = rts_base(handle);
+  for (int t = 0; t < threads; ++t) {
+    args[t] = ThreadArgs{handle, heap,
+                         salt * 1000003ULL + static_cast<uint64_t>(t) + 1,
+                         iters, 0};
+    if (pthread_create(&tids[t], nullptr, hammer, &args[t]) != 0) {
+      fprintf(stderr, "pthread_create failed\n");
+      exit(2);
+    }
+  }
+  long errors = 0;
+  for (int t = 0; t < threads; ++t) {
+    pthread_join(tids[t], nullptr);
+    errors += args[t].errors;
+  }
+  delete[] args;
+  delete[] tids;
+  return errors;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: %s <arena-path> [threads] [iters] [forks]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  int threads = argc > 2 ? atoi(argv[2]) : 8;
+  int iters = argc > 3 ? atoi(argv[3]) : 3000;
+  int forks = argc > 4 ? atoi(argv[4]) : 2;
+
+  void* handle = rts_open(path, kCapacity, kSlots, /*create=*/1);
+  if (handle == nullptr) {
+    fprintf(stderr, "rts_open(%s) failed\n", path);
+    return 2;
+  }
+
+  // Fork BEFORE spawning any thread (TSan supports single-threaded
+  // fork); children inherit the MAP_SHARED arena, so the pshared
+  // robust mutex is contended across real address spaces.
+  pid_t kids[16];
+  int nkids = 0;
+  long errors = 0;
+  if (forks > 16) forks = 16;
+  for (int f = 0; f < forks; ++f) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      // A failed fork must not reach waitpid(-1) (it would reap an
+      // arbitrary child and corrupt the pass/fail accounting).
+      fprintf(stderr, "fork %d failed\n", f);
+      ++errors;
+      continue;
+    }
+    if (pid == 0) {
+      long child_errors =
+          run_threads(handle, threads, iters, 100 + static_cast<uint64_t>(f));
+      _exit(child_errors == 0 ? 0 : 3);
+    }
+    kids[nkids++] = pid;
+  }
+
+  errors += run_threads(handle, threads, iters, 7);
+
+  for (int f = 0; f < nkids; ++f) {
+    int status = 0;
+    waitpid(kids[f], &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) {
+      fprintf(stderr, "child %d failed (status %d)\n", f, status);
+      ++errors;
+    }
+  }
+
+  uint64_t cap = 0, used = 0, num = 0;
+  rts_stats(handle, &cap, &used, &num);
+  if (used > cap) {
+    fprintf(stderr, "inconsistent stats: used %lu > capacity %lu\n",
+            static_cast<unsigned long>(used),
+            static_cast<unsigned long>(cap));
+    ++errors;
+  }
+  rts_close(handle, /*unlink_file=*/1, path);
+  if (errors != 0) {
+    fprintf(stderr, "%ld errors\n", errors);
+    return 3;
+  }
+  printf("TSAN-SWEEP-OK threads=%d iters=%d forks=%d objects=%lu\n",
+         threads, iters, forks, static_cast<unsigned long>(num));
+  return 0;
+}

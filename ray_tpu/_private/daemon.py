@@ -2220,7 +2220,7 @@ class NodeDaemon:
                 if client is not None:
                     try:
                         client.notify("delete_object", oid=oid.binary())
-                    except Exception:
+                    except Exception:  # rt: noqa[RT007] — best-effort fanout to a maybe-dead node; nothing to reply
                         pass
         return {}
 
@@ -2426,7 +2426,7 @@ class NodeDaemon:
         info = ActorInfo(
             actor_id=actor_id,
             name=spec.get("name"),
-            namespace=spec.get("namespace", "default"),
+            namespace=spec.get("namespace", "default"),  # rt: noqa[RT006] — wire-compat: specs from old clients lack the field
             state=ACTOR_PENDING_CREATION,
             class_name=spec.get("class_name", ""),
             max_restarts=spec.get("max_restarts", 0),
@@ -3002,10 +3002,10 @@ class NodeDaemon:
         if not self.is_head:
             return self.head.call(
                 "get_named_actor", name=msg["name"],
-                namespace=msg.get("namespace", "default"),
+                namespace=msg.get("namespace", "default"),  # rt: noqa[RT006] — wire-compat fallback for old clients
             )
         info = self.control.get_named_actor(
-            msg.get("namespace", "default"), msg["name"]
+            msg.get("namespace", "default"), msg["name"]  # rt: noqa[RT006] — wire-compat fallback for old clients
         )
         if info is None:
             return {"found": False}
@@ -3342,7 +3342,7 @@ class NodeDaemon:
                         task_id=spec["task_id"],
                         had_error=True,
                     )
-                except Exception:
+                except Exception:  # rt: noqa[RT007] — head may be mid-failover; resync will reconcile
                     pass
         if conn is not None:
             self._schedule()

@@ -69,7 +69,7 @@ _PIPELINE_CAP = 1
 #: queued tasks (~1 GB of the measured RSS). Critical sections are a
 #: few instructions, and completions arrive at RPC rate, so a shared
 #: lock contends negligibly.
-_fut_lock = threading.Lock()
+_fut_lock = threading.Lock()  # rt: noqa[RT004] — driver-only module state; workers re-import post-fork
 
 
 class ResultFuture:
@@ -624,7 +624,7 @@ class DirectTaskManager:
 
 
 _router_pool = None
-_router_pool_lock = threading.Lock()
+_router_pool_lock = threading.Lock()  # rt: noqa[RT004] — driver-only module state; workers re-import post-fork
 
 
 def _router_executor():

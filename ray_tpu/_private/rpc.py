@@ -161,7 +161,7 @@ class ConnectionLost(RpcError):
 # chaos / fault injection
 # ---------------------------------------------------------------------------
 
-_chaos_lock = threading.Lock()
+_chaos_lock = threading.Lock()  # rt: noqa[RT004] — guards test-only chaos budgets, held for a dict op
 _chaos_budget: Dict[str, int] = {}
 
 
@@ -546,7 +546,7 @@ class _SockState:
         self.closed = False
 
 
-_hub_lock = threading.Lock()
+_hub_lock = threading.Lock()  # rt: noqa[RT004] — the hub it guards is created lazily per process, post-fork
 _process_hub: Optional[SelectorHub] = None
 _client_pool = None
 

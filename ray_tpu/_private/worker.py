@@ -41,11 +41,17 @@ from .task_spec import (
 )
 
 _global_worker: Optional["CoreWorker"] = None
-_global_lock = threading.Lock()
+_global_lock = threading.Lock()  # rt: noqa[RT004] — held for one pointer swap; forked children re-init the worker
 
 #: Marker used to ship kwargs as a trailing positional arg (specs carry
 #: a flat arg list; see api_internal._flatten_args).
 KWARGS_MARKER = "__kwargs__"
+
+#: The anonymous session namespace (reference: ray's job config uses
+#: an empty/anonymous namespace unless ray.init(namespace=...) names
+#: one). Named here once; everywhere else resolves through the
+#: session/job context rather than repeating the literal (RT006).
+DEFAULT_NAMESPACE = "default"
 
 
 def _split_kwargs(flat):
@@ -111,9 +117,17 @@ class CoreWorker:
         self.role = role
         #: Default namespace for named-actor APIs in THIS process.
         #: The driver's is set from rt.init(namespace=...); worker
-        #: processes keep "default" — in-task named-actor calls that
-        #: need a session namespace must pass namespace= explicitly.
-        self.namespace = "default"
+        #: processes inherit the submitting driver's namespace through
+        #: the task/actor spec (`ns_ctx`, applied in _execute) so
+        #: in-task get_actor()/named-actor creation resolves against
+        #: the session namespace (reference: the job config propagates
+        #: ray_namespace to every worker of the job). The explicit
+        #: namespace= escape hatch on the APIs remains.
+        self.namespace = DEFAULT_NAMESPACE
+        #: Namespace context the actor hosted by this worker was
+        #: created under; actor tasks restore it (actors keep their
+        #: creating job's namespace for life).
+        self._actor_namespace: Optional[str] = None
         # Unique per-process token for session-scoped caches (unlike
         # id(), never reused after this worker is collected).
         self.generation = next(_worker_generation)
@@ -322,7 +336,7 @@ class CoreWorker:
 
     def _del_flush_loop(self) -> None:
         while self._running:
-            self._del_flush_evt.wait()  # parked while nothing pends
+            self._del_flush_evt.wait()  # rt: noqa[RT008] — deliberate park; shutdown() sets the event
             self._del_flush_evt.clear()
             if not self._running:
                 return
@@ -833,6 +847,12 @@ class CoreWorker:
             ),
             "max_retries": max_retries,
         }
+        if self.namespace != DEFAULT_NAMESPACE:
+            # Session-namespace context: the executing worker adopts it
+            # so nested named-actor APIs resolve against the driver's
+            # rt.init(namespace=...) (absent == default, like every
+            # other optional spec field).
+            spec["ns_ctx"] = self.namespace
         trace_ctx = _trace_ctx()
         if trace_ctx is not None:
             spec["trace_ctx"] = trace_ctx
@@ -858,7 +878,7 @@ class CoreWorker:
         args: Sequence[Any],
         class_name: str,
         name: Optional[str] = None,
-        namespace: str = "default",
+        namespace: Optional[str] = None,
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
@@ -877,7 +897,14 @@ class CoreWorker:
             "kind": "actor_creation",
             "trace_ctx": _trace_ctx(),
             "name": name,
-            "namespace": namespace,
+            # Named-actor registration defaults to the session
+            # namespace of the creating process, never a hardcoded one.
+            "namespace": namespace or self.namespace,
+            "ns_ctx": (
+                self.namespace
+                if self.namespace != DEFAULT_NAMESPACE
+                else None
+            ),
             "class_name": class_name,
             "function_key": class_key,
             "args": self._serialize_args(args),
@@ -943,6 +970,10 @@ class CoreWorker:
             "max_retries": max_retries,
             "num_returns_mode": mode,
             "concurrency_group": concurrency_group,
+            # No ns_ctx here: actor tasks run under the namespace the
+            # actor was CREATED with (its creation spec carried it) —
+            # shipping the caller's would be ~100 B/task of dead
+            # weight on the hot path.
         }
         spec = self._prune_spec(spec)
         if self._direct is not None:
@@ -1148,6 +1179,17 @@ class CoreWorker:
         self._ctx.pg_context = spec.get("pg_context") or (
             self._actor_pg_context if spec["kind"] == "actor_task" else None
         )
+        # Adopt the submitting driver's session namespace for the span
+        # of this task (reference: workers resolve named-actor APIs in
+        # the job's ray_namespace). Actors keep the namespace they
+        # were CREATED under — it is their identity's namespace — even
+        # if a later caller runs in another one.
+        if spec["kind"] == "actor_creation":
+            self._actor_namespace = spec.get("ns_ctx")
+        if spec["kind"] in ("actor_creation", "actor_task"):
+            self.namespace = self._actor_namespace or DEFAULT_NAMESPACE
+        else:
+            self.namespace = spec.get("ns_ctx") or DEFAULT_NAMESPACE
         self.job_id = JobID(spec["job_id"])
         trace_stack = None
         try:

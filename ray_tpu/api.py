@@ -51,10 +51,11 @@ def init(
         address=address,
     )
     # Session-scoped namespace: the default for named-actor creation,
-    # get_actor, and list_named_actors in THIS (driver) process
-    # (reference: ray.init(namespace)). Worker-side calls inside
-    # tasks/actors default to "default" — pass namespace= explicitly
-    # there.
+    # get_actor, and list_named_actors (reference: ray.init(namespace)).
+    # Propagated to workers through the task/actor spec (ns_ctx in
+    # _private/worker.py), so calls inside tasks/actors resolve against
+    # THIS namespace too; namespace= stays available as an explicit
+    # override everywhere.
     _session.worker.namespace = namespace
     return _session
 

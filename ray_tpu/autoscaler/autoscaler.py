@@ -117,11 +117,24 @@ class StandardAutoscaler:
             else:
                 flat.extend(bundles)
 
-        # Capacity pool: one entry per live daemon + one per HOST of
+        # Capacity pools: one entry per live daemon + one per HOST of
         # every launching provider node (a booting v5e-16 slice is 4
-        # distinct prospective hosts, not one blob).
+        # distinct prospective hosts, not one blob). Two parallel
+        # views of the same hosts:
+        #   pool     — AVAILABLE capacity; pending task/gang demand
+        #              packs here (it will actually consume it);
+        #   req_pool — TOTAL capacity; explicit resource_requests pack
+        #              here (reference: HandleRequestClusterResource-
+        #              Constraint checks node totals regardless of
+        #              utilization — a standing target asks "can the
+        #              cluster HOLD this", so a busy node still
+        #              satisfies its bundle and must not trigger an
+        #              over-launch or flap when tasks consume it).
         pool: List[Dict[str, float]] = [
             dict(node["available"]) for node in load["nodes"]
+        ]
+        req_pool: List[Dict[str, float]] = [
+            dict(node["total"]) for node in load["nodes"]
         ]
         provider_nodes = self.provider.non_terminated_nodes()
         counts: Dict[str, int] = {}
@@ -131,23 +144,21 @@ class StandardAutoscaler:
             if not self._daemons_of(p, load):  # still launching
                 cfg = self.node_types.get(node_type)
                 if cfg is not None:
-                    pool.extend(
-                        dict(cfg.resources)
-                        for _ in range(max(1, cfg.slice_hosts))
-                    )
+                    for _ in range(max(1, cfg.slice_hosts)):
+                        pool.append(dict(cfg.resources))
+                        req_pool.append(dict(cfg.resources))
 
         # min_workers floor. Floor-booked nodes contribute capacity to
-        # the pool so demand packed later (requests, tasks) does not
+        # the pools so demand packed later (requests, tasks) does not
         # double-launch what the floor already covers.
         to_launch: Dict[str, int] = {}
         for name, cfg in self.node_types.items():
             if counts.get(name, 0) < cfg.min_workers:
                 short = cfg.min_workers - counts.get(name, 0)
                 to_launch[name] = short
-                pool.extend(
-                    dict(cfg.resources)
-                    for _ in range(short * max(1, cfg.slice_hosts))
-                )
+                for _ in range(short * max(1, cfg.slice_hosts)):
+                    pool.append(dict(cfg.resources))
+                    req_pool.append(dict(cfg.resources))
 
         def _type_room(name: str) -> int:
             cfg = self.node_types[name]
@@ -158,8 +169,8 @@ class StandardAutoscaler:
         def _launch_for(request: Dict[str, float], distinct_needed=1):
             """Pick the first node type that fits `request` per host
             and can supply `distinct_needed` hosts in as few provider
-            nodes as possible. Returns pool entries added (one per new
-            host) or None."""
+            nodes as possible. Returns (available-pool entries,
+            total-pool entries) added — one per new host — or None."""
             for name, cfg in sorted(
                 self.node_types.items(),
                 # Prefer types whose slice covers the whole gang in
@@ -185,13 +196,22 @@ class StandardAutoscaler:
                     dict(cfg.resources)
                     for _ in range(nodes_needed * cfg.slice_hosts)
                 ]
+                fresh_total = [
+                    dict(cfg.resources)
+                    for _ in range(nodes_needed * cfg.slice_hosts)
+                ]
                 pool.extend(fresh)
-                return fresh
+                req_pool.extend(fresh_total)
+                return fresh, fresh_total
             return None
 
         # Explicit resource requests (reference: autoscaler sdk
         # request_resources): a standing TARGET the cluster must be
-        # able to hold. Satisfied bundles HOLD their nodes against
+        # able to hold. Bundles pack against node TOTALS (req_pool) —
+        # matching HandleRequestClusterResourceConstraint — so a node
+        # whose availability is temporarily consumed by tasks still
+        # satisfies its bundle instead of triggering extra launches
+        # and flapping. Satisfied bundles HOLD their nodes against
         # idle scale-down — terminating one would immediately recreate
         # the demand and flap the node back up.
         held_nodes: set = set()
@@ -200,7 +220,7 @@ class StandardAutoscaler:
         requests = load.get("resource_requests") or []
         for request in requests:
             placed = False
-            for idx, capacity in enumerate(pool):
+            for idx, capacity in enumerate(req_pool):
                 if _fits(request, capacity):
                     _consume(capacity, request)
                     if idx < daemon_count:
@@ -210,7 +230,7 @@ class StandardAutoscaler:
             if not placed:
                 added = _launch_for(request)
                 if added:
-                    _consume(added[0], request)
+                    _consume(added[1][0], request)
                 else:
                     # No node type fits (or max_workers reached): the
                     # standing target cannot be met — surface it
@@ -228,7 +248,7 @@ class StandardAutoscaler:
             if not placed:
                 added = _launch_for(request)
                 if added:
-                    _consume(added[0], request)
+                    _consume(added[0][0], request)
             # Unplaceable anywhere: reported, not fatal.
 
         # Pack gangs: each bundle on a DISTINCT pool entry; an unmet
@@ -263,7 +283,7 @@ class StandardAutoscaler:
                         need[name] = max(need.get(name, 0.0), amount)
                 added = _launch_for(need, len(unplaced))
                 if added:
-                    for request, capacity in zip(unplaced, added):
+                    for request, capacity in zip(unplaced, added[0]):
                         _consume(capacity, request)
 
         launched = []

@@ -19,12 +19,31 @@ def request_resources(
     """Set (REPLACE) the cluster's standing resource target.
 
     `num_cpus=N` expands to N one-CPU bundles (the reference's
-    semantics — aggregate CPU capacity, placeable anywhere).
-    `bundles` is a list of resource dicts that must each fit on some
-    node. Calling with neither (or `bundles=[]`) clears the target,
-    letting idle nodes scale down again. Returns the number of
-    bundles now standing.
+    semantics — aggregate CPU capacity, placeable anywhere); N must
+    be a non-negative integer (integral floats like `4.0` are
+    accepted; `2.5` is an error, never a silent truncation to 2, and
+    `num_cpus=0` is an explicit clear). `bundles` is a list of
+    resource dicts that must each fit on some node. Calling with
+    neither (or `bundles=[]`) clears the target, letting idle nodes
+    scale down again. Returns the number of bundles now standing.
     """
+    # Argument validation happens BEFORE any cluster traffic (and
+    # before the worker lookup): a bad target must never half-apply.
+    if num_cpus is not None:
+        if isinstance(num_cpus, bool) or not isinstance(
+            num_cpus, (int, float)
+        ):
+            raise TypeError(
+                f"num_cpus must be an integer, got "
+                f"{type(num_cpus).__name__}"
+            )
+        if num_cpus < 0:
+            raise ValueError(f"num_cpus must be >= 0, got {num_cpus}")
+        if isinstance(num_cpus, float) and not num_cpus.is_integer():
+            raise ValueError(
+                f"num_cpus must be a whole number of CPUs, got "
+                f"{num_cpus} (fractional targets are not truncated)"
+            )
     from .._private.worker import global_worker
 
     worker = global_worker()
@@ -32,8 +51,6 @@ def request_resources(
         raise RuntimeError("ray_tpu.init() has not been called")
     out: List[Dict[str, float]] = []
     if num_cpus:
-        if int(num_cpus) < 0:
-            raise ValueError(f"num_cpus must be >= 0, got {num_cpus}")
         out.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
     for bundle in bundles or ():
         # Same contract as placement_group(): non-empty

@@ -20,7 +20,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..actor import ActorMethod
-from .channels import ShmChannel
+from .channels import ChannelTimeoutError, ShmChannel
 from .tcp_channel import TcpChannel
 from .dag_node import (
     ClassMethodNode,
@@ -116,6 +116,18 @@ class CompiledDAG:
         self._next_read_seq = 0
         self._results: Dict[int, Any] = {}
         self._torn_down = False
+        #: DAG seqs whose execute() raised (no CompiledDAGRef exists
+        #: for them): their eventual outputs are read-and-discarded in
+        #: _read_result instead of cached forever.
+        self._orphan_seqs: set = set()
+        #: Tail of a timed-out execute(): [(chan, record, retry_token)]
+        #: for input channels that have NOT yet received that
+        #: submission's record. The next execute() (or teardown)
+        #: finishes these deliveries FIRST — with the channel's retry
+        #: token where one exists — so the torn submission lands
+        #: exactly once on every channel and the per-channel record
+        #: streams stay aligned with the DAG's seq accounting.
+        self._pending_inputs: List[tuple] = []
         #: [(channel, projection key | _WHOLE)] in bind order.
         self._input_channels: List[tuple] = []
         self._output_channels: List[ShmChannel] = []
@@ -289,11 +301,54 @@ class CompiledDAG:
             with self._lock:
                 if self._torn_down:
                     raise RuntimeError("compiled DAG was torn down")
+            # A previous execute() that timed out mid-fanout left some
+            # channels without its record; deliver those first (its
+            # DAG seq is already registered, so the streams must catch
+            # up before a new record may enter any channel).
+            self._drain_pending(timeout)
+            with self._lock:
                 seq = self._next_seq
                 self._next_seq += 1
-            for chan, payload in payloads:
-                chan.put(("v", payload), timeout=timeout)
+            for index, (chan, payload) in enumerate(payloads):
+                try:
+                    chan.put(("v", payload), timeout=timeout)
+                except ChannelTimeoutError as e:
+                    # Park the undelivered tail: THIS channel resumes
+                    # via the retry token (if the transport issued
+                    # one — a partially-sent TCP record), the rest
+                    # were never attempted. The seq is orphaned (the
+                    # caller gets this exception, never a ref), so its
+                    # output will be read-and-discarded.
+                    self._pending_inputs = [
+                        (chan, ("v", payload), getattr(e, "seq", None))
+                    ] + [
+                        (c, ("v", p), None)
+                        for c, p in payloads[index + 1:]
+                    ]
+                    with self._lock:
+                        self._orphan_seqs.add(seq)
+                    raise
         return CompiledDAGRef(self, seq)
+
+    def _drain_pending(self, timeout: Optional[float]) -> None:
+        """Finish the fanout of a timed-out execute() exactly once per
+        channel (caller holds the submit mutex). Raises
+        ChannelTimeoutError (keeping the remaining tail parked) if a
+        stage still isn't draining."""
+        while self._pending_inputs:
+            chan, record, token = self._pending_inputs[0]
+            try:
+                if token is not None:
+                    # TcpChannel: resume the exact pending record.
+                    chan.put(record, timeout=timeout, seq=token)
+                else:
+                    chan.put(record, timeout=timeout)
+            except ChannelTimeoutError as e:
+                self._pending_inputs[0] = (
+                    chan, record, getattr(e, "seq", token)
+                )
+                raise
+            self._pending_inputs.pop(0)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
         """Channel records arrive in submission order. A future whose
@@ -318,6 +373,12 @@ class CompiledDAG:
                 result = self._read_channels_once(timeout)
                 with self._lock:
                     self._next_read_seq = current + 1
+                    if current in self._orphan_seqs:
+                        # Output of a timed-out execute(): no ref will
+                        # ever claim it — discard instead of caching
+                        # it forever.
+                        self._orphan_seqs.discard(current)
+                        continue
                     if current == seq:
                         return result
                     self._results[current] = result
@@ -349,6 +410,12 @@ class CompiledDAG:
         # Stop tokens go through the submit mutex like any execute
         # (bounded puts: a wedged stage can't hang teardown).
         with self._submit_mutex:
+            # Best-effort: land any torn execute's records first so a
+            # stage never sees stop-then-orphan out of order.
+            try:
+                self._drain_pending(2.0)
+            except Exception:
+                pass
             for chan, _key in self._input_channels:
                 try:
                     chan.put(("s", None), timeout=5)

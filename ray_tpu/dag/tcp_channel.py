@@ -27,6 +27,19 @@ before the next record — so a record is never torn mid-frame, though
 unlike shm a put that timed out mid-send will still complete delivery
 on the next operation (TCP cannot un-send).
 
+Record identity: every record is framed with a per-channel
+monotonically increasing sequence number ([u64 len][u64 seq] header).
+A put that times out raises ``ChannelTimeoutError`` carrying the
+record's ``seq``; retrying the SAME record means calling
+``put_bytes(payload, seq=err.seq)`` — the channel finishes delivering
+that record exactly once. A put WITHOUT a retry token is always a new
+record, even if its bytes equal a pending one: dedup is by sequence
+number, never payload equality (two execute() calls with equal inputs
+are two records — comparing bytes silently dropped one and desynced
+the driver's result sequencing). The reader verifies the sequence is
+gapless and drops any duplicate seq, so the no-dup/no-loss guarantee
+is end-to-end.
+
 Dense tensor traffic between TPU pipeline stages still rides ICI
 collectives inside the jitted program (parallel/pipeline.py); these
 channels carry the control-plane records (activations for CPU stages,
@@ -45,7 +58,10 @@ from typing import Any, Optional
 
 from .channels import ChannelClosedError, ChannelTimeoutError
 
-_LEN = 8  # u64 length prefix, same framing as ShmChannel records
+#: Record header: u64 payload length + u64 sequence number. (The
+#: same-host shm ring keeps its bare length prefix — its records never
+#: retry across a reconnectable transport, so it needs no identity.)
+_HDR = 16
 _KV_NS = "dagchan"
 _POLL_S = 0.02
 
@@ -98,7 +114,16 @@ class TcpChannel:
         # continues the same record instead of desyncing the stream.
         self._rx = bytearray()
         self._tx = b""
-        self._tx_payload: Optional[bytes] = None
+        # Sequence framing (writer side): seq of the record currently
+        # pending in _tx, the next seq to allocate, and the highest
+        # seq fully handed to the kernel — a retry token is matched
+        # against these, so dedup is by record identity, never by
+        # payload bytes.
+        self._tx_seq: Optional[int] = None
+        self._next_tx_seq = 0
+        self._last_sent_seq = -1
+        # Reader side: next sequence number the stream owes us.
+        self._rx_next_seq = 0
 
     # -- rendezvous ----------------------------------------------------
     def bind_reader(self) -> None:
@@ -238,8 +263,18 @@ class TcpChannel:
 
     # -- IO ------------------------------------------------------------
     def put_bytes(self, payload: bytes,
-                  timeout: Optional[float] = None) -> None:
-        if len(payload) + _LEN > self.capacity:
+                  timeout: Optional[float] = None, *,
+                  seq: Optional[int] = None) -> int:
+        """Send one record; returns its sequence number.
+
+        `seq` is a RETRY TOKEN only: pass the `.seq` carried by a
+        previous ChannelTimeoutError to finish delivering that exact
+        record (already-delivered tokens are a no-op). Without a
+        token every call is a new record — identical bytes do NOT
+        make a retry (see module docstring: dedup is by sequence
+        number, never payload equality).
+        """
+        if len(payload) + _HDR > self.capacity:
             # Same contract as the shm ring: placement must not decide
             # whether an oversized record is accepted.
             raise ValueError(
@@ -247,47 +282,90 @@ class TcpChannel:
                 f"capacity {self.capacity}; recompile with a larger "
                 "buffer_size_bytes"
             )
+        if seq is not None and seq != self._tx_seq:
+            if seq <= self._last_sent_seq:
+                return seq  # retry of a fully delivered record: no-op
+            raise ValueError(
+                f"unknown retry token seq={seq} on {self.name} (pending="
+                f"{self._tx_seq}, last sent={self._last_sent_seq})"
+            )
         sock = self._ensure("writer", timeout)
         sock.settimeout(timeout)
         try:
             if self._tx:
-                # Finish the partially-sent previous record first. If
-                # the caller is retrying that exact record, flushing
-                # IS the send — don't queue a duplicate.
-                retry = payload == self._tx_payload
-                self._flush(sock)
-                if retry:
-                    self._tx_payload = None
-                    return
+                # Finish the partially-sent previous record first —
+                # the stream must never interleave frames. If the
+                # caller holds that record's retry token, flushing IS
+                # the send; otherwise this is a new record behind it.
+                pending_seq = self._tx_seq
+                try:
+                    self._flush_locked_state(sock)
+                except socket.timeout:
+                    err = ChannelTimeoutError(f"put on {self.name}")
+                    # The token belongs to whoever queued the pending
+                    # record. A caller submitting a NEW record gets no
+                    # token — its record was never accepted, so its
+                    # retry is a plain put_bytes() again.
+                    err.seq = pending_seq if seq == pending_seq else None
+                    raise err from None
+                if seq is not None and seq == pending_seq:
+                    return seq
+            cur = self._next_tx_seq
+            self._next_tx_seq += 1
             self._tx = memoryview(
-                struct.pack("<Q", len(payload)) + payload
+                struct.pack("<QQ", len(payload), cur) + payload
             )
-            self._tx_payload = payload
-            self._flush(sock)
-            self._tx_payload = None
-        except socket.timeout:
-            raise ChannelTimeoutError(f"put on {self.name}") from None
+            self._tx_seq = cur
+            try:
+                self._flush_locked_state(sock)
+            except socket.timeout:
+                err = ChannelTimeoutError(f"put on {self.name}")
+                # The retry token: put_bytes(payload, seq=err.seq)
+                # resumes THIS record instead of queueing a duplicate.
+                err.seq = cur
+                raise err from None
+            return cur
         except OSError:
             raise ChannelClosedError(self.name) from None
 
-    def _flush(self, sock: socket.socket) -> None:
+    def _flush_locked_state(self, sock: socket.socket) -> None:
         while self._tx:
             n = sock.send(self._tx)
             self._tx = self._tx[n:]
+        if self._tx_seq is not None:
+            self._last_sent_seq = max(self._last_sent_seq, self._tx_seq)
+            self._tx_seq = None
 
     def get_bytes(self, timeout: Optional[float] = None) -> bytes:
         sock = self._ensure("reader", timeout)
         sock.settimeout(timeout)
         try:
-            while len(self._rx) < _LEN:
-                self._recv_into(sock, 65536)
-            (size,) = struct.unpack_from("<Q", self._rx)
-            total = _LEN + size
-            while len(self._rx) < total:
-                self._recv_into(sock, min(total - len(self._rx), 1 << 20))
-            payload = bytes(self._rx[_LEN:total])
-            del self._rx[:total]
-            return payload
+            while True:
+                while len(self._rx) < _HDR:
+                    self._recv_into(sock, 65536)
+                size, seq = struct.unpack_from("<QQ", self._rx)
+                total = _HDR + size
+                while len(self._rx) < total:
+                    self._recv_into(
+                        sock, min(total - len(self._rx), 1 << 20)
+                    )
+                payload = bytes(self._rx[_HDR:total])
+                del self._rx[:total]
+                if seq == self._rx_next_seq:
+                    self._rx_next_seq = seq + 1
+                    return payload
+                if seq < self._rx_next_seq:
+                    # Duplicate of a delivered record (writer-side
+                    # dedup failed us): drop it — end-to-end exactly-
+                    # once beats trusting the peer.
+                    continue
+                # A gap means records were lost or the peer desynced;
+                # no read can ever succeed again — fail loudly rather
+                # than hand the caller out-of-order results.
+                raise RuntimeError(
+                    f"{self.name}: sequence gap (expected "
+                    f"{self._rx_next_seq}, got {seq})"
+                )
         except socket.timeout:
             # _rx keeps the partial record; the retried get() resumes.
             raise ChannelTimeoutError(f"get on {self.name}") from None
@@ -300,8 +378,14 @@ class TcpChannel:
             raise ChannelClosedError(self.name)
         self._rx += chunk
 
-    def put(self, value: Any, timeout: Optional[float] = None) -> None:
-        self.put_bytes(pickle.dumps(value), timeout=timeout)
+    def put(self, value: Any, timeout: Optional[float] = None, *,
+            seq: Optional[int] = None) -> int:
+        """Pickle + send; returns the record's seq. `seq` is the retry
+        token from a previous put's ChannelTimeoutError (`err.seq`) —
+        it makes the retry finish delivering THAT record instead of
+        queueing a duplicate (see put_bytes)."""
+        return self.put_bytes(pickle.dumps(value), timeout=timeout,
+                              seq=seq)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         return pickle.loads(self.get_bytes(timeout=timeout))

@@ -1,0 +1,16 @@
+"""Developer tooling: the distributed-correctness linter.
+
+`ray_tpu lint [paths]` (scripts/cli.py) or programmatic:
+
+    from ray_tpu.devtools import lint_paths
+    findings = lint_paths(["ray_tpu"])
+
+Rules RT001-RT008 live in devtools/rules.py; the engine (single AST
+walk per file, `# rt: noqa[RTxxx]` suppressions, JSON output) in
+devtools/lint.py. The repo lints itself in tests/test_lint.py, so
+every new framework idiom either passes the rules or carries an
+explicit, reviewable suppression.
+"""
+
+from .lint import Finding, lint_paths, lint_source, main  # noqa: F401
+from .rules import ALL_RULES  # noqa: F401

@@ -93,7 +93,7 @@ class JobManager:
         return job_id
 
     def _watch(self, job_id: str, proc: subprocess.Popen) -> None:
-        code = proc.wait()
+        code = proc.wait()  # rt: noqa[RT008] — a job runs until IT decides; liveness is the daemon's job
         with self._lock:
             job = self._jobs[job_id]
             if job["status"] == JobStatus.RUNNING.value:

@@ -466,6 +466,22 @@ def cmd_serve_shutdown(args) -> None:
     print("serve shut down")
 
 
+def cmd_lint(args) -> None:
+    """`ray_tpu lint [paths]` — the framework-aware distributed-
+    correctness linter (devtools/lint.py, rules RT001-RT008). Runs
+    offline on source trees; no cluster connection."""
+    from ..devtools.lint import main as lint_main
+
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.list_rules:
+        argv.append("--list-rules")
+    sys.exit(lint_main(argv))
+
+
 def cmd_dashboard(args) -> None:
     """Serve the dashboard against a running cluster until SIGINT /
     SIGTERM (reference: the head starts ray's dashboard; here it
@@ -613,6 +629,26 @@ def main(argv=None) -> None:
     )
     p_sdown.add_argument("--address")
     p_sdown.set_defaults(fn=cmd_serve_shutdown)
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="distributed-correctness linter (rules RT001-RT008)",
+    )
+    p_lint.add_argument(
+        "paths", nargs="*", help="files/dirs to lint (default: ray_tpu)"
+    )
+    p_lint.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON (CI mode)",
+    )
+    p_lint.add_argument(
+        "--rules", help="comma-separated rule ids to run"
+    )
+    p_lint.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    p_lint.set_defaults(fn=cmd_lint)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

@@ -37,7 +37,7 @@ def list_named_actors(all_namespaces: bool = False):
     return [
         row["name"]
         for row in rows
-        if row.get("namespace", "default") == mine
+        if row.get("namespace", "default") == mine  # rt: noqa[RT006] — wire-compat: rows from old daemons lack the field
     ]
 
 

@@ -291,7 +291,7 @@ def run(
 ) -> Any:
     """Execute (or continue) a workflow to completion and return the
     final result (reference: workflow.run, api.py:123)."""
-    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"  # rt: noqa[RT003] — id minted once at submission, never replayed
     store = _WorkflowStorage(_root(storage), workflow_id)
     store.save_dag(dag, input_value)
     store.save_meta(

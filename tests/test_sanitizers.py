@@ -1,8 +1,18 @@
-"""Sanitizer pass over the native arena store (SURVEY §5.2 — the
+"""Sanitizer passes over the native arena store (SURVEY §5.2 — the
 reference ships ASAN/UBSAN/TSAN build modes and sanitizer CI for its
-C++ core; here the C++ surface is store.cc, exercised under
-AddressSanitizer + UndefinedBehaviorSanitizer in a subprocess with the
-sanitizer runtime preloaded)."""
+C++ core; here the C++ surface is store.cc):
+
+* ASan + UBSan: the Python binding's full API sweep runs in a
+  subprocess with the sanitizer runtime preloaded (memory errors,
+  UB).
+* TSan (slow-marked — the instrumented build+run costs real time):
+  a standalone instrumented binary (_native/tsan_exerciser.cc)
+  hammers one arena from many threads and forked processes —
+  concurrent create/seal/pin/evict/delete against the process-shared
+  robust mutex. TSan cannot be preloaded into an uninstrumented
+  python, hence the dedicated main(). Skips cleanly where the
+  toolchain lacks -fsanitize=thread.
+"""
 
 import os
 import subprocess
@@ -151,6 +161,62 @@ def _libasan() -> str:
     if not out or not os.path.exists(out):
         pytest.skip("libasan runtime not found")
     return out
+
+
+_TSAN_EXE = "/tmp/rt_store_tsan_{}".format(
+    _hashlib.sha1(_NATIVE_DIR.encode()).hexdigest()[:10]
+)
+
+
+@pytest.fixture(scope="module")
+def tsan_exe():
+    """Build the instrumented exerciser once per checkout; skip when
+    the toolchain can't produce -fsanitize=thread binaries."""
+    store = os.path.join(_NATIVE_DIR, "store.cc")
+    exerciser = os.path.join(_NATIVE_DIR, "tsan_exerciser.cc")
+    newest_src = max(os.path.getmtime(store), os.path.getmtime(exerciser))
+    if (
+        not os.path.exists(_TSAN_EXE)
+        or os.path.getmtime(_TSAN_EXE) < newest_src
+    ):
+        try:
+            build = subprocess.run(
+                [
+                    "g++", "-O1", "-g", "-std=c++17",
+                    "-fsanitize=thread",
+                    store, exerciser, "-o", _TSAN_EXE, "-lpthread",
+                ],
+                capture_output=True, text=True, timeout=180,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            pytest.skip(f"cannot build TSan exerciser: {e}")
+        if build.returncode != 0:
+            pytest.skip(
+                "toolchain lacks -fsanitize=thread: "
+                + build.stderr[-500:]
+            )
+    return _TSAN_EXE
+
+
+@pytest.mark.slow
+def test_store_concurrency_under_tsan(tsan_exe, tmp_path):
+    """Concurrent create/seal/pin/evict/delete from 3 processes x 6
+    threads against ONE arena must be race-clean: any report from the
+    instrumented build (data race, mutex misuse, deadlock) fails the
+    run (halt_on_error) and the exit code."""
+    arena = str(tmp_path / "tsan_arena")
+    proc = subprocess.run(
+        [tsan_exe, arena, "6", "4000", "2"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(
+            os.environ,
+            TSAN_OPTIONS="halt_on_error=1 second_deadlock_stack=1",
+        ),
+    )
+    output = proc.stdout + proc.stderr
+    assert proc.returncode == 0, output[-4000:]
+    assert "TSAN-SWEEP-OK" in output, output[-4000:]
+    assert "WARNING: ThreadSanitizer" not in output, output[-4000:]
 
 
 def test_arena_sweep_under_asan_ubsan(sanitized_so):
