@@ -141,7 +141,9 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
     refs = worker.submit_task(
         func_key,
         _flatten_args(args, kwargs),
-        name=rf.underlying.__name__,
+        # name= is a display-name override (reference: task options
+        # name); the option-key universe lives in _private/options.py.
+        name=opts.get("name") or rf.underlying.__name__,
         num_returns=num_returns,
         resources=resources,
         max_retries=opts.get("max_retries", worker.config.task_max_retries),

@@ -67,6 +67,7 @@ from .wire import (
     encode_frame,  # noqa: F401 — contiguous-frame path for tests
     encode_frame_buffers,
 )
+from .wire import has_schema as _schema_known
 from .wire import validate as _schema_validate
 
 _LEN = struct.Struct(">Q")
@@ -721,6 +722,21 @@ class RpcServer:
             return
         t_start = time.monotonic()
         queue_s = (t_start - t_enq) if t_enq else 0.0
+        if not _schema_known(method) and method not in _schemaless_warned:
+            # Once per process per method: a served-but-unschema'd
+            # method skips typed validation entirely — always a
+            # framework bug (wire.SCHEMAS describes our own plane),
+            # caught statically by `ray_tpu check` RT104 but made
+            # loud here too for out-of-tree handlers.
+            _schemaless_warned.add(method)
+            import sys as _sys
+
+            print(
+                f"[rpc] method {method!r} is served without a "
+                "wire.SCHEMAS entry; arguments are not validated "
+                "(add a schema — see ray_tpu check RT104)",
+                file=_sys.stderr,
+            )
         # Typed argument validation (wire.SCHEMAS): malformed frames
         # get a clean schema error instead of a KeyError mid-handler.
         schema_err = _schema_validate(method, msg)
@@ -806,6 +822,11 @@ class RpcServer:
 #: Sentinel a handler returns to indicate it will reply later via
 #: `Connection.reply(mid, ...)` (used for blocking ops like object gets).
 DEFERRED = object()
+
+#: Methods already warned about for missing wire schemas (once per
+#: process; set.add is GIL-atomic, a duplicate warning on a race is
+#: harmless).
+_schemaless_warned: set = set()
 
 
 class Connection:

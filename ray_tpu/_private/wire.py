@@ -255,6 +255,15 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
 }
 
 
+def has_schema(method: str) -> bool:
+    """Whether `method` has a registered argument schema. Dispatch
+    (rpc.RpcServer._dispatch) warns once per process for methods
+    served without one — schema-less dispatch skips typed validation,
+    which is exactly the drift `ray_tpu check` (RT104) exists to
+    catch."""
+    return method in SCHEMAS
+
+
 def validate(method: str, msg: Dict[str, Any]) -> Optional[str]:
     """Check `msg` against the method's schema. Returns an error
     string, or None when valid. Methods without a registered schema

@@ -13,8 +13,14 @@ from ._private.ids import ActorID
 
 class ActorClass:
     def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        from ._private.options import validate_options
+
         self._cls = cls
         self._options = dict(options or {})
+        # Decorator and .options() clones both construct through here:
+        # unknown keys raise with the valid key set instead of being
+        # silently merged (the RT102 bug class, enforced at runtime).
+        validate_options("actor", self._options)
         self._exported_key: Optional[str] = None
         functools.update_wrapper(self, cls, updated=[])
 

@@ -82,7 +82,12 @@ def remote(*args, **options):
     """Decorator turning a function into a RemoteFunction or a class
     into an ActorClass. Supports bare `@remote` and
     `@remote(num_cpus=..., num_tpus=..., resources=..., num_returns=...,
-    max_retries=..., name=..., max_restarts=...)`."""
+    max_retries=..., name=..., max_restarts=...)`.
+
+    Option keys are validated against the shared key universe
+    (`_private/options.py` — the same table `ray_tpu check` enforces
+    statically): an unknown key raises ValueError naming the bad key
+    and the valid set, instead of being silently ignored."""
     if len(args) == 1 and not options and callable(args[0]):
         return _make_remote(args[0], {})
     if args:

@@ -66,12 +66,23 @@ class StandardAutoscaler:
         *,
         idle_timeout_s: float = 5.0,
         upscaling_speed: float = 1.0,
+        launch_timeout_s: float = 600.0,
     ):
         self.provider = provider
         self.node_types = node_types
         self.idle_timeout_s = idle_timeout_s
         self.upscaling_speed = upscaling_speed
+        #: How long a provider node's not-yet-joined hosts count as
+        #: launching capacity. Past it, a missing host is presumed
+        #: dead, not booting — its capacity stops masking demand, so a
+        #: gang waiting on it launches replacements instead of
+        #: wedging forever (reference: the autoscaler's node launch
+        #: timeout / NODE_STARTUP_TIMEOUT).
+        self.launch_timeout_s = launch_timeout_s
         self._last_busy: Dict[str, float] = {}
+        #: provider node -> first time this reconcile loop saw it
+        #: (drives the launch timeout above).
+        self._first_seen: Dict[str, float] = {}
         self._client = None
         self._launched_types: Dict[str, int] = {}
 
@@ -136,17 +147,33 @@ class StandardAutoscaler:
         req_pool: List[Dict[str, float]] = [
             dict(node["total"]) for node in load["nodes"]
         ]
+        now = time.time()
         provider_nodes = self.provider.non_terminated_nodes()
+        self._first_seen = {
+            p: self._first_seen.get(p, now) for p in provider_nodes
+        }
         counts: Dict[str, int] = {}
         for p in provider_nodes:
             node_type = self.provider.node_type(p)
             counts[node_type] = counts.get(node_type, 0) + 1
-            if not self._daemons_of(p, load):  # still launching
-                cfg = self.node_types.get(node_type)
-                if cfg is not None:
-                    for _ in range(max(1, cfg.slice_hosts)):
-                        pool.append(dict(cfg.resources))
-                        req_pool.append(dict(cfg.resources))
+            cfg = self.node_types.get(node_type)
+            if cfg is None:
+                continue
+            # Launching capacity is counted PER HOST, not per node: a
+            # booting v5e-16 slice whose first daemon has joined still
+            # owes 3 more hosts, and those prospective hosts must
+            # cover the pending gang's remainder — or every reconcile
+            # tick during the multi-host boot window launches another
+            # whole slice (the test_slice_pg double-launch bug). Only
+            # within the launch timeout: past it a missing host is
+            # dead, and phantom capacity would wedge the gang forever.
+            if now - self._first_seen[p] > self.launch_timeout_s:
+                continue
+            joined = len(self._daemons_of(p, load))
+            missing = max(1, cfg.slice_hosts) - joined
+            for _ in range(max(0, missing)):
+                pool.append(dict(cfg.resources))
+                req_pool.append(dict(cfg.resources))
 
         # min_workers floor. Floor-booked nodes contribute capacity to
         # the pools so demand packed later (requests, tasks) does not
@@ -300,7 +327,6 @@ class StandardAutoscaler:
         # when EVERY host daemon is idle (reference: idle node
         # termination; v2 kills whole TPU pods, never partial slices).
         terminated = []
-        now = time.time()
         for p in list(provider_nodes):
             daemons = self._daemons_of(p, load)
             if not daemons:

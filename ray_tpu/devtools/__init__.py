@@ -1,16 +1,86 @@
-"""Developer tooling: the distributed-correctness linter.
+"""Developer tooling: static analysis for distributed correctness.
 
-`ray_tpu lint [paths]` (scripts/cli.py) or programmatic:
+Two layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
+`--json`, exit 0/1/2):
 
-    from ray_tpu.devtools import lint_paths
-    findings = lint_paths(["ray_tpu"])
+* `ray_tpu lint [paths]` — per-file, syntactic (rules RT001-RT008 in
+  devtools/rules.py; engine in devtools/lint.py). "Is this line an
+  idiom this codebase has shipped bugs with?"
+* `ray_tpu check [paths]` — whole-program, two-phase (symbol table in
+  devtools/contracts.py; rules RT101-RT106 in devtools/check.py).
+  "Do the two sides of this process boundary still agree?" —
+  `.remote()` arity vs decorated signatures, `.options()` keys vs the
+  shared option universe (`_private/options.py`), RPC call sites vs
+  registered handlers and `wire.SCHEMAS`.
+* `ray_tpu devtools all [paths]` — both, merged, as one CI gate.
 
-Rules RT001-RT008 live in devtools/rules.py; the engine (single AST
-walk per file, `# rt: noqa[RTxxx]` suppressions, JSON output) in
-devtools/lint.py. The repo lints itself in tests/test_lint.py, so
-every new framework idiom either passes the rules or carries an
-explicit, reviewable suppression.
+Programmatic:
+
+    from ray_tpu.devtools import lint_paths, check_paths
+    findings = lint_paths(["ray_tpu"]) + check_paths(["ray_tpu"])
+
+The repo holds itself to both layers in tests/test_lint.py and
+tests/test_check.py, so every new idiom or cross-process contract
+either passes the rules or carries an explicit, reviewable
+suppression.
 """
 
+from .check import check_paths, check_sources  # noqa: F401
+from .check import main as check_main  # noqa: F401
 from .lint import Finding, lint_paths, lint_source, main  # noqa: F401
 from .rules import ALL_RULES  # noqa: F401
+
+
+def all_main(argv=None, out=None) -> int:
+    """`ray_tpu devtools all [paths] [--json]` — lint + check over the
+    same tree with merged findings: the single CI gate. Shares the
+    individual tools' default-path, validation, rendering, and
+    exit-code behavior (0 clean, 1 findings, 2 usage errors) so the
+    gate can never diverge from running them separately."""
+    import argparse
+    import json as _json
+    import os
+    import sys
+    from dataclasses import asdict
+
+    out = out if out is not None else sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu devtools all",
+        description="lint + check with merged findings (single CI gate)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", help="files/dirs (default: ray_tpu)"
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit merged findings as JSON (CI mode)",
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code else 0
+    # Same default as lint/check main(): the package this CLI shipped
+    # in, never a cwd-relative "ray_tpu".
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"devtools: no such path(s): {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+    findings = lint_paths(paths) + check_paths(paths)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if args.as_json:
+        print(
+            _json.dumps([asdict(f) for f in findings], indent=2),
+            file=out,
+        )
+    else:
+        for finding in findings:
+            print(finding.render(), file=out)
+        if findings:
+            print(f"{len(findings)} finding(s)", file=out)
+    return 1 if findings else 0

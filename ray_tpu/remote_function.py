@@ -10,8 +10,14 @@ from typing import Any, Dict, Optional
 
 class RemoteFunction:
     def __init__(self, func, options: Optional[Dict[str, Any]] = None):
+        from ._private.options import validate_options
+
         self._function = func
         self._options = dict(options or {})
+        # Every construction path (decorator, .options() clone) funnels
+        # here: a typo'd key raises with the valid key set instead of
+        # being silently merged and ignored at submission.
+        validate_options("task", self._options)
         self._exported_key: Optional[str] = None
         functools.update_wrapper(self, func)
 

@@ -482,6 +482,35 @@ def cmd_lint(args) -> None:
     sys.exit(lint_main(argv))
 
 
+def cmd_check(args) -> None:
+    """`ray_tpu check [paths]` — the whole-program contract checker
+    (devtools/check.py, rules RT101-RT106). Offline: builds a symbol
+    table over the tree and cross-checks every .remote()/.options()/
+    RPC call site; no cluster connection."""
+    from ..devtools.check import main as check_main
+
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.list_rules:
+        argv.append("--list-rules")
+    sys.exit(check_main(argv))
+
+
+def cmd_devtools_all(args) -> None:
+    """`ray_tpu devtools all [paths]` — lint + check as one CI gate
+    with merged findings (devtools.all_main; JSON mode emits one
+    combined list)."""
+    from ..devtools import all_main
+
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    sys.exit(all_main(argv))
+
+
 def cmd_dashboard(args) -> None:
     """Serve the dashboard against a running cluster until SIGINT /
     SIGTERM (reference: the head starts ray's dashboard; here it
@@ -649,6 +678,47 @@ def main(argv=None) -> None:
         help="print the rule table and exit",
     )
     p_lint.set_defaults(fn=cmd_lint)
+
+    p_check = sub.add_parser(
+        "check",
+        help="whole-program contract checker (rules RT101-RT106)",
+    )
+    p_check.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to check as one program (default: ray_tpu)",
+    )
+    p_check.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON (CI mode)",
+    )
+    p_check.add_argument(
+        "--rules", help="comma-separated rule ids to run"
+    )
+    p_check.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    p_check.set_defaults(fn=cmd_check)
+
+    p_devtools = sub.add_parser(
+        "devtools", help="combined static-analysis gates"
+    )
+    devtools_sub = p_devtools.add_subparsers(
+        dest="devtools_cmd", required=True
+    )
+    p_all = devtools_sub.add_parser(
+        "all",
+        help="run lint + check with merged findings (single CI gate)",
+    )
+    p_all.add_argument(
+        "paths", nargs="*", help="files/dirs (default: ray_tpu)"
+    )
+    p_all.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit merged findings as JSON (CI mode)",
+    )
+    p_all.set_defaults(fn=cmd_devtools_all)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

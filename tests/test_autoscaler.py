@@ -276,3 +276,143 @@ def test_request_resources_scales_up_holds_then_releases(scaling_cluster):
     while time.time() < deadline and cluster.num_workers() > 0:
         time.sleep(0.3)
     assert cluster.num_workers() == 0
+
+
+# ---------------------------------------------------------------------------
+# regression: partially-joined slices still count as launching capacity
+# ---------------------------------------------------------------------------
+
+
+class _FakeSliceProvider:
+    """One 4-host slice node type; records create_node calls."""
+
+    head_address = "unused"
+
+    def __init__(self, nodes=()):
+        self.nodes = list(nodes)
+        self.created = []
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+    def node_type(self, p):
+        return "tpu-slice"
+
+    def cluster_node_id(self, p):
+        return None
+
+    def create_node(self, node_type, resources, labels):
+        name = f"slice-{len(self.created)}"
+        self.created.append(name)
+        self.nodes.append(name)
+        return name
+
+    def terminate_node(self, p):
+        self.nodes.remove(p)
+
+
+def _slice_autoscaler(provider):
+    from ray_tpu.autoscaler.autoscaler import (
+        NodeTypeConfig,
+        StandardAutoscaler,
+    )
+
+    return StandardAutoscaler(
+        provider,
+        {
+            "tpu-slice": NodeTypeConfig(
+                resources={"CPU": 2.0, "TPU": 4.0},
+                max_workers=4,
+                slice_hosts=4,
+            )
+        },
+        idle_timeout_s=999.0,
+    )
+
+
+def _gang_load(joined_hosts):
+    """A pending 4-bundle STRICT_SPREAD gang + `joined_hosts` daemons
+    of provider node slice-0 already registered (mid-boot)."""
+    nodes = [
+        {
+            "node_id": "head",
+            "available": {"CPU": 1.0},
+            "total": {"CPU": 1.0},
+            "queued": 0,
+            "labels": {},
+        }
+    ]
+    for i in range(joined_hosts):
+        nodes.append(
+            {
+                "node_id": f"d{i}",
+                "available": {"CPU": 2.0, "TPU": 4.0},
+                "total": {"CPU": 2.0, "TPU": 4.0},
+                "queued": 0,
+                "labels": {"rt.io/provider-node": "slice-0"},
+            }
+        )
+    return {
+        "infeasible": [],
+        "pending_placement_groups": [
+            {
+                "strategy": "STRICT_SPREAD",
+                "bundles": [{"TPU": 4.0}] * 4,
+            }
+        ],
+        "nodes": nodes,
+        "resource_requests": [],
+    }
+
+
+@pytest.mark.parametrize("joined", [0, 1, 2, 3])
+def test_partially_joined_slice_is_not_relaunched(joined):
+    """The double-launch bug: while a 4-host slice boots, each
+    reconcile tick sees SOME daemons joined and — if the remaining
+    hosts aren't counted as launching capacity — launches another
+    whole slice for the gang's unplaced remainder. Any join state of
+    an already-launched slice must satisfy the gang with zero new
+    nodes."""
+    provider = _FakeSliceProvider(nodes=["slice-0"])
+    autoscaler = _slice_autoscaler(provider)
+    autoscaler._load = lambda: _gang_load(joined)
+    result = autoscaler.update()
+    assert result["launched"] == [], (
+        f"joined={joined}: relaunched a booting slice"
+    )
+    assert provider.created == []
+
+
+def test_unlaunched_gang_still_launches_exactly_one_slice():
+    """Sanity: with NO provider node yet, the same gang launches one
+    slice (not four single hosts)."""
+    provider = _FakeSliceProvider()
+    autoscaler = _slice_autoscaler(provider)
+    autoscaler._load = lambda: _gang_load(0)
+    load = autoscaler._load()
+    load["nodes"] = load["nodes"][:1]  # head only
+    autoscaler._load = lambda: load
+    result = autoscaler.update()
+    assert len(result["launched"]) == 1
+    assert provider.created == ["slice-0"]
+
+
+def test_dead_slice_host_stops_masking_demand_after_launch_timeout():
+    """A slice past its launch timeout with a missing host must NOT
+    keep contributing phantom 'launching' capacity: the gang would
+    wedge forever waiting on a dead host. Past the timeout the
+    remainder launches a replacement slice."""
+    provider = _FakeSliceProvider(nodes=["slice-0"])
+    autoscaler = _slice_autoscaler(provider)
+    autoscaler.launch_timeout_s = 60.0
+    autoscaler._load = lambda: _gang_load(3)  # 3 of 4 hosts, 1 dead
+    # Simulate the slice having been seen long before the timeout.
+    autoscaler._first_seen["slice-0"] = time.time() - 999.0
+    result = autoscaler.update()
+    assert len(result["launched"]) == 1, "gang wedged on a dead host"
+    # Within the timeout the same state launches nothing (booting).
+    provider2 = _FakeSliceProvider(nodes=["slice-0"])
+    autoscaler2 = _slice_autoscaler(provider2)
+    autoscaler2.launch_timeout_s = 60.0
+    autoscaler2._load = lambda: _gang_load(3)
+    assert autoscaler2.update()["launched"] == []

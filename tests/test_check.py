@@ -1,0 +1,758 @@
+"""Whole-program contract checker tests (`ray_tpu check`,
+devtools/check.py + contracts.py) and the runtime half of RT102
+(RemoteFunction/ActorClass/@rt.remote option-key validation).
+
+Every rule RT101-RT106 has at least one fixture tree that triggers it
+and one that stays quiet; the repo checks itself clean (package AND
+tests) — so signature/wire drift either gets fixed or carries an
+explicit reviewed `# rt: noqa[RTxxx]`, mirroring tests/test_lint.py.
+"""
+
+import io
+import json
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.check import check_paths, check_sources, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fired(files):
+    """files: {relpath: source}. Returns {rule ids} over the tree
+    checked as one program."""
+    sources = [
+        (path, textwrap.dedent(source)) for path, source in files.items()
+    ]
+    return {f.rule for f in check_sources(sources)}
+
+
+#: A minimal server+schema backdrop for the RPC rules: one registered,
+#: schema'd, called method so RT103/RT104 global passes are armed.
+SERVER = """
+class Daemon:
+    def __init__(self, server):
+        for name in ["kv_put", "kv_get"]:
+            server.register(name, getattr(self, "_h_" + name))
+
+    def _h_kv_put(self, conn, msg): ...
+    def _h_kv_get(self, conn, msg): ...
+
+SCHEMAS = {
+    "kv_put": {"key": (str, bytes), "value": bytes, "?ns": str},
+    "kv_get": {"key": (str, bytes), "?ns": str},
+}
+"""
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures
+# ---------------------------------------------------------------------------
+
+CASES = [
+    # --- RT101: .remote() arity vs decorated signature ----------------
+    (
+        "RT101",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def add(a, b):
+                return a + b
+
+            def driver():
+                return add.remote(1, 2, 3)
+            """
+        },
+        True,
+    ),
+    (
+        "RT101",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def add(a, b=0):
+                return a + b
+
+            def driver():
+                return add.remote(1)
+            """
+        },
+        False,
+    ),
+    (
+        # actor-method call through a typed handle
+        "RT101",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            class Counter:
+                def __init__(self, start):
+                    self.v = start
+
+                def incr(self, by=1):
+                    self.v += by
+
+            def driver():
+                h = Counter.remote(0)
+                return h.incr.remote(1, 2)
+            """
+        },
+        True,
+    ),
+    (
+        "RT101",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            class Counter:
+                def __init__(self, start):
+                    self.v = start
+
+                def incr(self, by=1):
+                    self.v += by
+
+            def driver():
+                h = Counter.remote(0)
+                return h.incr.remote(by=2)
+            """
+        },
+        False,
+    ),
+    (
+        # unknown method on a typed handle
+        "RT101",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            class Counter:
+                def incr(self):
+                    pass
+
+            def driver():
+                h = Counter.remote()
+                return h.nope.remote()
+            """
+        },
+        True,
+    ),
+    # --- RT102: option keys -------------------------------------------
+    (
+        "RT102",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def f():
+                return 1
+
+            def driver():
+                return f.options(num_cpu=1).remote()
+            """
+        },
+        True,
+    ),
+    (
+        "RT102",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def f():
+                return 1
+
+            def driver():
+                return f.options(num_cpus=1, max_retries=2).remote()
+            """
+        },
+        False,
+    ),
+    (
+        # invalid-typed literal
+        "RT102",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def f():
+                return 1
+
+            def driver():
+                return f.options(num_cpus="two").remote()
+            """
+        },
+        True,
+    ),
+    (
+        # decorator-site unknown key on an actor
+        "RT102",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote(max_restart=1)
+            class A:
+                def m(self):
+                    pass
+            """
+        },
+        True,
+    ),
+    # --- RT103: handler registry --------------------------------------
+    (
+        "RT103",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client):
+                return client.call("frobnicate")
+            """,
+        },
+        True,
+    ),
+    (
+        "RT103",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client):
+                client.call("kv_put", key="k", value=b"v")
+                return client.call("kv_get", key="k")
+            """,
+        },
+        False,
+    ),
+    (
+        # dead handler: registered, schema'd, never named anywhere
+        "RT103",
+        {
+            "server.py": SERVER
+            + """
+class Extra:
+    def __init__(self, server):
+        server.register("dead_verb", self._h_dead_verb)
+
+    def _h_dead_verb(self, conn, msg): ...
+
+SCHEMAS["dead_verb"] = {}
+""",
+            "app.py": """
+            def driver(client):
+                return client.call("kv_get", key="k")
+            """,
+        },
+        True,
+    ),
+    (
+        # a dynamic-dispatch string witness keeps a handler alive
+        "RT103",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client, bundle_call):
+                bundle_call(b"node", "kv_put", key="k", value=b"v")
+                return client.call("kv_get", key="k")
+            """,
+        },
+        False,
+    ),
+    # --- RT104: wire-schema drift -------------------------------------
+    (
+        "RT104",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client):
+                return client.call("kv_put", key="k", value=b"v", wrong=1)
+            """,
+        },
+        True,
+    ),
+    (
+        # missing required field
+        "RT104",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client):
+                return client.call("kv_put", key="k")
+            """,
+        },
+        True,
+    ),
+    (
+        # **kwargs expansion: explicit keys checked, required relaxed
+        "RT104",
+        {
+            "server.py": SERVER,
+            "app.py": """
+            def driver(client, kw):
+                client.call("kv_put", **kw)
+                return client.call("kv_put", key="k", value=b"v", ns="n",
+                                   timeout=5, retries=2)
+            """,
+        },
+        False,
+    ),
+    (
+        # handler served without any schema entry
+        "RT104",
+        {
+            "server.py": SERVER
+            + """
+class Extra:
+    def __init__(self, server):
+        server.register("no_schema", self._h_no_schema)
+
+    def _h_no_schema(self, conn, msg): ...
+""",
+            "app.py": """
+            def driver(client):
+                client.notify("no_schema")
+                return client.call("kv_get", key="k")
+            """,
+        },
+        True,
+    ),
+    # --- RT105: unserializable .remote() args -------------------------
+    (
+        "RT105",
+        {
+            "app.py": """
+            import threading
+            import ray_tpu as rt
+
+            @rt.remote
+            def work(sync):
+                return sync
+
+            def driver():
+                lock = threading.Lock()
+                return work.remote(lock)
+            """
+        },
+        True,
+    ),
+    (
+        "RT105",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def work(payload):
+                return payload
+
+            def driver():
+                data = open("f").read()  # the VALUE crosses, not the file
+                return work.remote(data)
+            """
+        },
+        False,
+    ),
+    (
+        # direct constructor in the call, keyword position
+        "RT105",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def work(out=None):
+                return out
+
+            def driver():
+                return work.remote(out=open("log.txt", "w"))
+            """
+        },
+        True,
+    ),
+    # --- RT106: discarded task refs -----------------------------------
+    (
+        "RT106",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def fire():
+                return 1
+
+            def driver():
+                fire.remote()
+            """
+        },
+        True,
+    ),
+    (
+        "RT106",
+        {
+            "app.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def fire():
+                return 1
+
+            def driver():
+                ref = fire.remote()
+                return rt.get(ref)
+            """
+        },
+        False,
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,files,expect",
+    CASES,
+    ids=[
+        f"{c[0]}-{'fires' if c[2] else 'quiet'}-{i}"
+        for i, c in enumerate(CASES)
+    ],
+)
+def test_rule_fixtures(rule, files, expect):
+    rules = fired(files)
+    if expect:
+        assert rule in rules, f"{rule} did not fire on its fixture"
+    else:
+        assert rule not in rules, f"{rule} false-positived: {rules}"
+
+
+# ---------------------------------------------------------------------------
+# resolution precision
+# ---------------------------------------------------------------------------
+
+
+def test_same_name_symbols_resolve_per_scope():
+    """Two test-style functions each defining `@rt.remote class A`
+    must each resolve THEIR A (the lexical-shadowing bug class)."""
+    rules_and_findings = check_sources(
+        [
+            (
+                "app.py",
+                textwrap.dedent(
+                    """
+                    import ray_tpu as rt
+
+                    def test_one():
+                        @rt.remote
+                        class A:
+                            def ping(self):
+                                return 1
+
+                        h = A.remote()
+                        return h.ping.remote()
+
+                    def test_two():
+                        @rt.remote
+                        class A:
+                            def __init__(self, x):
+                                self.x = x
+
+                            def pong(self):
+                                return 2
+
+                        h = A.remote(5)
+                        return h.pong.remote()
+                    """
+                ),
+            )
+        ]
+    )
+    assert rules_and_findings == [], [
+        f.render() for f in rules_and_findings
+    ]
+
+
+def test_cross_file_import_resolution():
+    """A .remote() call in one file is checked against the decorated
+    signature defined in ANOTHER file (the whole-program property)."""
+    rules = fired(
+        {
+            "lib/tasks.py": """
+            import ray_tpu as rt
+
+            @rt.remote
+            def transform(block, fn):
+                return fn(block)
+            """,
+            "driver.py": """
+            from lib.tasks import transform
+
+            def run():
+                return transform.remote(1, 2, 3)
+            """,
+        }
+    )
+    assert "RT101" in rules
+
+
+def test_inherited_actor_methods_not_flagged():
+    """Methods from a base class are invisible to the class-body scan;
+    unknown-method judgments must stay silent for derived actors."""
+    findings = check_sources(
+        [
+            (
+                "app.py",
+                textwrap.dedent(
+                    """
+                    import ray_tpu as rt
+
+                    class Base:
+                        def ping(self):
+                            return 1
+
+                    @rt.remote
+                    class Child(Base):
+                        def own(self):
+                            return 2
+
+                    def driver():
+                        h = Child.remote()
+                        h.own.remote()  # rt: noqa[RT106]
+                        return h.ping.remote()  # inherited: no finding
+                    """
+                ),
+            )
+        ]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unresolvable_receivers_stay_silent():
+    """serve-style handles and unknown receivers are never judged."""
+    findings = check_sources(
+        [
+            (
+                "app.py",
+                textwrap.dedent(
+                    """
+                    def route(handle, replica):
+                        handle.options(stream=True).remote(None)
+                        replica["actor"].m.remote(1, 2, 3, 4, 5)
+                    """
+                ),
+            )
+        ]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# suppressions / output modes / CLI
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_suppressions():
+    bad = (
+        "import ray_tpu as rt\n"
+        "\n"
+        "@rt.remote\n"
+        "def f(a):\n"
+        "    return a\n"
+        "\n"
+        "def driver():\n"
+        "    f.remote()\n"
+    )
+    assert {f.rule for f in check_sources([("m.py", bad)])} == {
+        "RT101",
+        "RT106",
+    }
+    one = bad.replace("f.remote()", "f.remote()  # rt: noqa[RT106]")
+    assert {f.rule for f in check_sources([("m.py", one)])} == {"RT101"}
+    both = bad.replace(
+        "f.remote()", "f.remote()  # rt: noqa[RT101,RT106]"
+    )
+    assert check_sources([("m.py", both)]) == []
+    blanket = bad.replace("f.remote()", "f.remote()  # rt: noqa")
+    assert check_sources([("m.py", blanket)]) == []
+
+
+def test_json_output_roundtrip(tmp_path):
+    target = tmp_path / "app.py"
+    target.write_text(
+        "import ray_tpu as rt\n"
+        "\n"
+        "@rt.remote\n"
+        "def f():\n"
+        "    return 1\n"
+        "\n"
+        "def driver():\n"
+        "    return f.options(num_cpu=1).remote()\n"
+    )
+    out = io.StringIO()
+    code = main(["--json", str(tmp_path)], out=out)
+    assert code == 1
+    findings = json.loads(out.getvalue())
+    assert len(findings) == 1
+    finding = findings[0]
+    assert finding["rule"] == "RT102"
+    assert finding["path"] == str(target)
+    assert finding["line"] == 8
+    assert "num_cpu" in finding["message"]
+    assert "num_cpus" in finding["message"]  # names the valid set
+
+
+def test_rules_filter_and_errors(tmp_path):
+    target = tmp_path / "app.py"
+    target.write_text(
+        "import ray_tpu as rt\n"
+        "\n"
+        "@rt.remote\n"
+        "def f(a):\n"
+        "    return a\n"
+        "\n"
+        "def driver():\n"
+        "    f.remote()\n"
+    )
+    unfiltered = io.StringIO()
+    assert main([str(tmp_path)], out=unfiltered) == 1
+    assert "RT101" in unfiltered.getvalue()
+    assert "RT106" in unfiltered.getvalue()
+    out = io.StringIO()
+    assert main(["--rules", "RT106", str(tmp_path)], out=out) == 1
+    assert "RT101" not in out.getvalue()
+    assert "RT106" in out.getvalue()
+    assert main(["--rules", "RT999", str(tmp_path)], out=io.StringIO()) == 2
+    assert main([str(tmp_path / "nope.py")], out=io.StringIO()) == 2
+    assert main(["--list-rules"], out=io.StringIO()) == 0
+
+
+def test_repo_checks_clean():
+    """`ray_tpu check ray_tpu/ tests/` exits 0: every cross-program
+    contract in the tree holds, or carries a reviewed noqa."""
+    out = io.StringIO()
+    code = main(
+        [os.path.join(REPO, "ray_tpu"), os.path.join(REPO, "tests")],
+        out=out,
+    )
+    assert code == 0, f"repo check not clean:\n{out.getvalue()}"
+
+
+def test_devtools_all_merged_gate(tmp_path, capsys):
+    """`ray_tpu devtools all` runs lint + check and merges findings
+    into one JSON list (the single CI gate)."""
+    from ray_tpu.scripts.cli import main as cli_main
+
+    target = tmp_path / "dag" / "app.py"
+    target.parent.mkdir()
+    # One lint finding (RT002 payload dedup) + one check finding
+    # (RT101 arity) in the same tree.
+    target.write_text(
+        "import ray_tpu as rt\n"
+        "\n"
+        "@rt.remote\n"
+        "def f():\n"
+        "    return 1\n"
+        "\n"
+        "def dedup(payload, prev):\n"
+        "    return payload == prev\n"
+        "\n"
+        "def driver():\n"
+        "    return f.remote(1)\n"
+    )
+    with pytest.raises(SystemExit) as excinfo:
+        cli_main(["devtools", "all", str(tmp_path), "--json"])
+    assert excinfo.value.code == 1
+    findings = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in findings}
+    assert "RT002" in rules and "RT101" in rules
+    # Clean tree exits 0 with an empty list.
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("x = 1\n")
+    with pytest.raises(SystemExit) as excinfo:
+        cli_main(["devtools", "all", str(clean), "--json"])
+    assert excinfo.value.code == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+# ---------------------------------------------------------------------------
+# runtime counterpart of RT102: unknown option keys raise
+# ---------------------------------------------------------------------------
+
+
+def test_options_rejects_unknown_task_keys():
+    import ray_tpu as rt
+
+    @rt.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError) as excinfo:
+        f.options(num_cpu=1)  # rt: noqa[RT102] — the raise IS the test
+    msg = str(excinfo.value)
+    assert "num_cpu" in msg  # names the bad key
+    assert "num_cpus" in msg and "max_retries" in msg  # valid key set
+
+    # valid keys still merge fine
+    assert f.options(num_cpus=2).task_options["num_cpus"] == 2
+
+
+def test_options_rejects_unknown_actor_keys():
+    import ray_tpu as rt
+
+    @rt.remote
+    class A:
+        def m(self):
+            return 1
+
+    with pytest.raises(ValueError) as excinfo:
+        A.options(max_restart=1)  # rt: noqa[RT102] — the raise IS the test
+    msg = str(excinfo.value)
+    assert "max_restart" in msg
+    assert "max_restarts" in msg and "namespace" in msg
+
+    assert A.options(max_restarts=1).actor_options["max_restarts"] == 1
+
+
+def test_decorator_rejects_unknown_keys():
+    import ray_tpu as rt
+
+    with pytest.raises(ValueError, match="num_gpu"):
+
+        @rt.remote(num_gpu=1)  # rt: noqa[RT102] — the raise IS the test
+        def f():
+            return 1
+
+    with pytest.raises(ValueError, match="concurrency_group\\b"):
+
+        @rt.remote(concurrency_group={"io": 1})  # plural is the key  # rt: noqa[RT102]
+        class A:
+            pass
+
+
+def test_internal_skip_pg_rewrite_key_still_accepted():
+    """placement_groups.py submits its marker task with the internal
+    _skip_pg_rewrite key — documented in the universe, not rejected."""
+    import ray_tpu as rt
+
+    @rt.remote
+    def marker():
+        return 1
+
+    clone = marker.options(num_cpus=0, _skip_pg_rewrite=True)
+    assert clone.task_options["_skip_pg_rewrite"] is True
+
+
+def test_schema_registry_has_has_schema():
+    from ray_tpu._private import wire
+
+    assert wire.has_schema("kv_put")
+    assert not wire.has_schema("definitely_not_a_method")

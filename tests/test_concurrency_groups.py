@@ -101,7 +101,7 @@ def test_unknown_group_rejected(rt_session):
 
     a = A.remote()
     with pytest.raises(ValueError, match="unknown concurrency group"):
-        a.f.options(concurrency_group="nope").remote()
+        a.f.options(concurrency_group="nope").remote()  # rt: noqa[RT106] — submit raises; no ref exists
 
     with pytest.raises(ValueError, match="unknown concurrency group"):
         @rt.remote(concurrency_groups={"io": 1})

@@ -181,8 +181,8 @@ def test_actor_ordering():
             return self.items
 
     a = Appender.remote()
-    for i in range(20):
-        a.append.remote(i)
+    refs = [a.append.remote(i) for i in range(20)]
+    rt.get(refs)  # surface append errors instead of discarding refs
     assert rt.get(a.get.remote()) == list(range(20))
 
 

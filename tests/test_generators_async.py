@@ -85,11 +85,11 @@ def test_streaming_non_generator_rejected(rt_session):
 
     with pytest.raises(ValueError, match="num_returns"):
 
-        @rt.remote(num_returns="bogus")
+        @rt.remote(num_returns="bogus")  # rt: noqa[RT102] — deliberate bad literal under test
         def bad():
             yield 1
 
-        bad.remote()
+        bad.remote()  # rt: noqa[RT106] — submit raises; no ref exists
 
 
 def test_actor_streaming_method(rt_session):

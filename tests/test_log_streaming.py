@@ -172,7 +172,7 @@ def test_error_event_from_remote_node_reaches_driver(capfd):
                 os._exit(1)
 
         d = RemoteDies.remote()
-        d.boom.remote()  # never get()
+        d.boom.remote()  # never get()  # rt: noqa[RT106] — the test IS about an unobserved death
         _wait_for(capfd, "dead:")
     finally:
         rt.shutdown()

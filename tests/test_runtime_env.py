@@ -110,7 +110,7 @@ def test_conda_rejected(rt_session):
         return 1
 
     with pytest.raises(exc.RuntimeEnvSetupError):
-        nope.remote()
+        nope.remote()  # rt: noqa[RT106] — submit raises; no ref exists
 
 
 def test_unknown_field_rejected(rt_session):
@@ -121,4 +121,4 @@ def test_unknown_field_rejected(rt_session):
         return 1
 
     with pytest.raises(ValueError, match="bogus_field"):
-        nope.remote()
+        nope.remote()  # rt: noqa[RT106] — submit raises; no ref exists

@@ -68,7 +68,7 @@ def test_uv_rejected_without_binary(rt_session, tmp_path):
     os.environ["PATH"] = str(empty)
     try:
         with pytest.raises(exc.RuntimeEnvSetupError, match="uv"):
-            nope.remote()
+            nope.remote()  # rt: noqa[RT106] — submit raises; no ref exists
     finally:
         os.environ["PATH"] = old_path
 
