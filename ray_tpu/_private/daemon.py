@@ -1880,8 +1880,12 @@ class NodeDaemon:
                 meta = self._meta_reply(oid)
             else:
                 try:
+                    # retries: a transiently dropped meta RPC (chaos
+                    # injection, head failover blip) must not abandon
+                    # the pull — nothing re-arms it until an unrelated
+                    # seal event.
                     meta = self.head.call(
-                        "get_object_meta", oid=oid.binary()
+                        "get_object_meta", oid=oid.binary(), retries=3
                     )
                 except RpcError:
                     return

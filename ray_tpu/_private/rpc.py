@@ -1218,6 +1218,12 @@ class RpcClient:
         ``{"_error": "__connection_lost__"}`` on connection loss). The
         hot path of the direct task transport: no per-call thread
         handoff on the send side."""
+        if _chaos_should_fail(method):
+            # Same contract as a send failure: the callback fires
+            # synchronously on the caller's thread (callers already
+            # handle that for the closed-client path).
+            callback({"_error": "__chaos_injected_failure__"})
+            return
         with self._lock:
             if self._closed:
                 callback({"_error": "__connection_lost__"})

@@ -12,7 +12,9 @@ output_splitter.py) feeds Train workers disjoint streams.
 from __future__ import annotations
 
 import builtins
+import queue as _queue
 import random
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -60,6 +62,102 @@ class ActorPoolStrategy:
         self.max_size = max_size
         self.max_tasks_per_actor = max_tasks_per_actor
         self.num_cpus = num_cpus
+
+
+#: How far the block-ref stream pulls ahead of a prefetching batch
+#: iterator: keeps the streaming executor submitting upstream tasks
+#: while the prefetch thread is blocked inside an rt.get.
+_REF_PULL_AHEAD = 2
+
+
+def _prefetched(iterator: Iterator[Any], window: int) -> Iterator[Any]:
+    """Run `iterator` on a background thread, yielding its items in
+    order through a bounded queue (reference: iter_batches
+    prefetch_batches -> _internal/block_batching prefetcher).
+
+    Contract: order-preserving; exceptions from the producer re-raise
+    at the consumer's next(); closing the returned generator (early
+    `break`, GC) stops the producer promptly, closes the wrapped
+    iterator (cascading cancellation for nested prefetchers), and
+    joins the thread — no leaked threads, no dangling gets beyond the
+    one already in flight.
+    """
+    out: _queue.Queue = _queue.Queue(maxsize=max(1, window))
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Blocking put that aborts when the consumer went away."""
+        while not stop.is_set():
+            try:
+                out.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            try:
+                for item in iterator:
+                    if not _put(("item", item)) or stop.is_set():
+                        return  # consumer gone: do not start another get
+                _put(("done", None))
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                _put(("error", e))
+        finally:
+            close = getattr(iterator, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    thread = threading.Thread(
+        target=producer, daemon=True, name="rt-data-prefetch"
+    )
+
+    def consume():
+        # Started on first next(): a generator that is created but
+        # never consumed must not leave a producer thread behind.
+        thread.start()
+        try:
+            while True:
+                kind, value = out.get()
+                if kind == "item":
+                    yield value
+                elif kind == "done":
+                    return
+                else:
+                    raise value
+        finally:
+            stop.set()
+            # No drain needed: a producer blocked in put() re-checks
+            # stop every 0.1s and exits WITHOUT consuming another
+            # item from the wrapped iterator (draining here would let
+            # its put succeed and the loop advance into one more
+            # blocking get).
+            thread.join(timeout=10.0)
+
+    return consume()
+
+
+def _batches_from_blocks(
+    blocks: Iterator[Block],
+    batch_size: int,
+    batch_format: str,
+    drop_last: bool,
+) -> Iterator[Any]:
+    """The one batching loop: both the serial and the prefetching
+    iter_batches run THIS code, so ordering and drop_last semantics
+    cannot drift between them."""
+    carry: Block = []
+    for block in blocks:
+        carry.extend(block)
+        while len(carry) >= batch_size:
+            yield format_batch(carry[:batch_size], batch_format)
+            carry = carry[batch_size:]
+    if carry and not drop_last:
+        yield format_batch(carry, batch_format)
 
 
 class Dataset:
@@ -288,12 +386,22 @@ class Dataset:
             )
         return self._materialized
 
-    def iter_block_refs(self) -> Iterator[Any]:
+    def iter_block_refs(self, *, prefetch: int = 0) -> Iterator[Any]:
+        """Yield output block refs. prefetch>0 pulls up to that many
+        refs ahead of the consumer on a background thread, keeping the
+        streaming executor submitting upstream tasks while the
+        consumer is busy (e.g. blocked in rt.get on an earlier
+        block)."""
         if self._materialized is not None:
+            # Already-resident refs: a pull-ahead thread over an
+            # in-memory list buys nothing.
             return iter(self._materialized)
-        return execute_streaming(
+        base = execute_streaming(
             self._stages, self._window, self._inflight_bytes
         )
+        if prefetch > 0:
+            return _prefetched(base, prefetch)
+        return base
 
     def materialize(self) -> "Dataset":
         self._block_refs()
@@ -312,15 +420,31 @@ class Dataset:
         batch_size: int = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_batches: int = 0,
     ) -> Iterator[Any]:
-        carry: Block = []
-        for ref in self.iter_block_refs():
-            carry.extend(rt.get(ref))
-            while len(carry) >= batch_size:
-                yield format_batch(carry[:batch_size], batch_format)
-                carry = carry[batch_size:]
-        if carry and not drop_last:
-            yield format_batch(carry, batch_format)
+        """Formatted batches over the block stream.
+
+        prefetch_batches=k (k>0) moves block resolution (rt.get) and
+        batch formatting onto a background thread holding up to k
+        finished batches ahead of the consumer, with the block-ref
+        stream itself pulled ahead — the training step never waits on
+        the input pipeline once the window fills. k=0 is the exact
+        serial path. Both paths run the same batching loop, so
+        ordering and drop_last semantics are identical by
+        construction.
+        """
+        ref_pull_ahead = _REF_PULL_AHEAD if prefetch_batches > 0 else 0
+
+        def blocks() -> Iterator[Block]:
+            for ref in self.iter_block_refs(prefetch=ref_pull_ahead):
+                yield rt.get(ref)
+
+        batches = _batches_from_blocks(
+            blocks(), batch_size, batch_format, drop_last
+        )
+        if prefetch_batches > 0:
+            return _prefetched(batches, prefetch_batches)
+        return batches
 
     def take(self, n: int = 20) -> List[dict]:
         out: List[dict] = []
@@ -374,6 +498,7 @@ class Dataset:
         drop_last: bool = False,
         device: Optional[str] = None,
         dtypes=None,
+        prefetch_batches: int = 0,
     ) -> Iterator[Dict[str, Any]]:
         """Batches as dicts of torch tensors (reference:
         Dataset.iter_torch_batches). Non-numeric columns pass through
@@ -384,6 +509,7 @@ class Dataset:
             batch_size=batch_size,
             batch_format="numpy",
             drop_last=drop_last,
+            prefetch_batches=prefetch_batches,
         ):
             out: Dict[str, Any] = {}
             for key, column in batch.items():
@@ -605,15 +731,17 @@ class DataIterator:
         batch_size: int = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_batches: int = 0,
     ) -> Iterator[Any]:
-        carry: Block = []
-        for block in self.iter_blocks():
-            carry.extend(block)
-            while len(carry) >= batch_size:
-                yield format_batch(carry[:batch_size], batch_format)
-                carry = carry[batch_size:]
-        if carry and not drop_last:
-            yield format_batch(carry, batch_format)
+        """Same prefetch contract as Dataset.iter_batches: k>0 pulls
+        coordinator blocks and formats batches on a background thread,
+        k=0 is the serial path; identical ordering either way."""
+        batches = _batches_from_blocks(
+            self.iter_blocks(), batch_size, batch_format, drop_last
+        )
+        if prefetch_batches > 0:
+            return _prefetched(batches, prefetch_batches)
+        return batches
 
     def __reduce__(self):
         return (DataIterator, (self._coordinator, self._index))

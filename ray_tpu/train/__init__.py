@@ -5,8 +5,10 @@ from .backend import Backend, CpuTestBackend, JaxBackend
 from .checkpoint import (
     CheckpointManager,
     load_metadata,
+    pending_checkpoints,
     restore_checkpoint,
     save_checkpoint,
+    wait_for_checkpoints,
 )
 from .config import (
     CheckpointConfig,
@@ -19,12 +21,14 @@ from .session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_device_batches,
     report,
 )
 from .train_step import (
     TrainState,
     default_optimizer,
     make_train_step,
+    prefetch_to_device,
     shard_batch,
 )
 from .trainer import JaxTrainer
@@ -45,12 +49,16 @@ __all__ = [
     "make_train_step",
     "default_optimizer",
     "shard_batch",
+    "prefetch_to_device",
     "report",
     "get_context",
     "get_checkpoint",
     "get_dataset_shard",
+    "get_device_batches",
     "save_checkpoint",
     "restore_checkpoint",
     "load_metadata",
+    "wait_for_checkpoints",
+    "pending_checkpoints",
     "CheckpointManager",
 ]

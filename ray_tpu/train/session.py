@@ -78,6 +78,12 @@ def init_session(
 ) -> _Session:
     session = _Session(context, result_callback, dataset_shards)
     if context.trial_dir:
+        # A retrying attempt in the same process may race its failed
+        # predecessor's async checkpoint write: settle pending saves
+        # before judging which marker paths exist on disk.
+        from .checkpoint import wait_for_checkpoints
+
+        wait_for_checkpoints()
         marker = os.path.join(context.trial_dir, _CKPT_MARKER)
         try:
             with open(marker) as f:
@@ -132,3 +138,40 @@ def get_dataset_shard(name: str = "train"):
             "trainer"
         )
     return session.dataset_shards[name]
+
+
+def get_device_batches(
+    name: str = "train",
+    *,
+    mesh,
+    batch_size: int = 256,
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+    prefetch_batches: int = 2,
+    buffer_size: int = 2,
+    logical_axes=("batch",),
+    rules=None,
+):
+    """This rank's shard as device-resident batches with the whole
+    overlap pipeline engaged: a background thread resolves and formats
+    host batches `prefetch_batches` ahead (DataIterator.iter_batches),
+    and `buffer_size` of them are device_put ahead of consumption so
+    batch N+1 is on the mesh before step N retires. The train loop's
+    only remaining critical-path work is the step itself."""
+    from ..parallel.sharding import ACT_RULES
+    from .train_step import prefetch_to_device
+
+    shard = get_dataset_shard(name)
+    batches = shard.iter_batches(
+        batch_size=batch_size,
+        batch_format=batch_format,
+        drop_last=drop_last,
+        prefetch_batches=prefetch_batches,
+    )
+    return prefetch_to_device(
+        batches,
+        mesh,
+        buffer_size=buffer_size,
+        logical_axes=logical_axes,
+        rules=rules if rules is not None else ACT_RULES,
+    )

@@ -181,3 +181,46 @@ def shard_batch(batch, mesh: Mesh, logical_axes=("batch", "seq"),
     """Device-put host batches onto the mesh data axes."""
     sharding = NamedSharding(mesh, spec_for(logical_axes, rules))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def prefetch_to_device(
+    batches,
+    mesh: Mesh,
+    *,
+    buffer_size: int = 2,
+    logical_axes: Tuple[Optional[str], ...] = ("batch", "seq"),
+    rules: Rules = ACT_RULES,
+):
+    """Double-buffer host batches onto the mesh: batch N+1's
+    device_put is dispatched before batch N is consumed, so its H2D
+    transfer overlaps step N's compute (flax.jax_utils
+    prefetch_to_device pattern; device_put is an async dispatch on
+    TPU/GPU backends).
+
+    `batches` is any iterator of pytrees (e.g. Dataset.iter_batches
+    output); each leaf is device_put with the same sharding
+    shard_batch would use. buffer_size=2 is classic double buffering;
+    1 degenerates to put-then-yield with no overlap.
+    """
+    from collections import deque
+
+    if buffer_size < 1:
+        raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+    sharding = NamedSharding(mesh, spec_for(logical_axes, rules))
+
+    def put(batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(x, sharding), batch
+        )
+
+    window: "deque" = deque()
+    iterator = iter(batches)
+    while True:
+        while len(window) < buffer_size:
+            try:
+                window.append(put(next(iterator)))
+            except StopIteration:
+                while window:
+                    yield window.popleft()
+                return
+        yield window.popleft()

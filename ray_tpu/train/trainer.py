@@ -21,6 +21,7 @@ import traceback
 from typing import Any, Callable, Dict, Optional
 
 from .backend import Backend, JaxBackend
+from .checkpoint import wait_for_checkpoints
 from .config import Result, RunConfig, ScalingConfig
 from .session import TrainContext, clear_session, init_session
 from .worker_group import WorkerGroup
@@ -137,6 +138,10 @@ class JaxTrainer:
             self._train_loop(*self._loop_args())
         finally:
             clear_session()
+            # Fit-exit durability barrier: async saves issued by the
+            # loop must be on disk before fit() returns (or before a
+            # retry attempt restores from them).
+            wait_for_checkpoints()
         metrics = history[-1] if history else {}
         return Result(
             metrics=metrics,

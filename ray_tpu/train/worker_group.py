@@ -50,6 +50,12 @@ class _TrainWorker:
             result = fn(*args)
         finally:
             clear_session()
+            # Fit-exit durability barrier, worker-side: this rank's
+            # async checkpoint saves must persist before the gang
+            # result (which names them) reaches the trainer.
+            from .checkpoint import wait_for_checkpoints
+
+            wait_for_checkpoints()
         return {
             "result": result,
             "reported": session.results,

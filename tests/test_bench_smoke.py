@@ -1,0 +1,71 @@
+"""CI gate for the bench harness itself: `bench.py --smoke` must run
+the whole bench surface (train step, fixed-cost attribution, async-
+checkpoint overhead) in seconds on CPU and emit one well-formed JSON
+line — so a broken bench is caught by the test suite, not discovered
+at measurement time."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# slow: ~90s of jit compiles on a loaded CPU box — the smoke gate
+# belongs in the slow tier, not displacing tier-1 wall-clock.
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_bench_smoke_emits_composite_json():
+    # Drop the suite's forced 8-host-device XLA_FLAGS: the smoke gate
+    # mirrors `python bench.py --smoke` as a user runs it (1 CPU
+    # device), and CPU SPMD across forced devices is pathologically
+    # slow.
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # Keep the checkpoint-overhead phase short: this test checks the
+    # bench RUNS and emits the right shape, not the numbers.
+    env.setdefault("RT_BENCH_SMOKE_CKPT_STEPS", "6")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "bench.py"),
+            "--smoke",
+            "--skip-micro",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=390,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [
+        ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+
+    assert out["smoke"] is True
+    assert out["vs_baseline"] == 0.0  # smoke numbers never count
+    assert out["train"]["cpu_fallback"] is True
+
+    breakdown = out["fixed_ms_breakdown"]
+    for key in (
+        "fixed_step_ms_0l",
+        "optimizer_ms",
+        "embed_lm_head_ms",
+        "dispatch_ms",
+        "host_sync_ms",
+        "input_stall_ms",
+    ):
+        assert isinstance(breakdown[key], (int, float)), key
+        assert breakdown[key] >= 0, (key, breakdown[key])
+
+    ckpt = out["ckpt_overhead"]
+    assert ckpt["every"] == 10
+    assert ckpt["base_wall_s"] > 0
+    assert ckpt["ckpt_wall_s"] > 0
+    assert isinstance(ckpt["ckpt_overhead_pct"], (int, float))

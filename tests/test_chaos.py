@@ -1,0 +1,112 @@
+"""Fault-injection tests: the RT_testing_rpc_failure chaos hook
+(reference: rpc_chaos.h + python/ray/tests/test_network_failure_e2e —
+inject RPC drops on the object-transfer plane and assert the workload
+still converges through the retry machinery).
+
+The hook (_private/rpc.py configure_chaos) drops the first N calls of
+a named RPC method at the client side. These tests aim it at the
+pull/push object-transfer methods (`pull_object` chunk requests and
+the `get_object_meta` lookups that precede them) while running a
+task + put/get workload across a two-node cluster: every injected
+drop must be absorbed by a retry, never surfacing to the user or
+corrupting data.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def _shared_cluster():
+    """One two-node cluster for the whole module: cluster boot is the
+    dominant cost of these tests, and chaos state is reset around each
+    test (see chaos_cluster) so sharing is safe."""
+    from ray_tpu.cluster_utils import Cluster
+
+    import ray_tpu as rt
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    c.add_node(num_cpus=2, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+        yield rt, c
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+
+@pytest.fixture
+def chaos_cluster(_shared_cluster):
+    from ray_tpu._private.rpc import configure_chaos
+
+    configure_chaos("")  # never inherit budgets from a prior test
+    try:
+        yield _shared_cluster
+    finally:
+        configure_chaos("")  # never leak budgets into other tests
+
+
+def test_cross_node_get_converges_under_pull_chaos(chaos_cluster):
+    """Driver-side get of a remotely produced object while the first
+    pull_object chunk RPCs are chaos-dropped: the pull retry loop
+    (daemon._pull_once x5 attempts) must converge to the right
+    bytes."""
+    from ray_tpu._private.rpc import configure_chaos
+
+    rt, _ = chaos_cluster
+
+    @rt.remote(resources={"remote_node": 1.0})
+    def produce():
+        return np.arange(1_000_000, dtype=np.int64)  # 8 MB: 2 chunks
+
+    ref = produce.remote()
+    # Arm chaos only once the task path has settled, so the drops hit
+    # the object-transfer plane, not task submission.
+    configure_chaos("pull_object=3")
+    out = rt.get(ref, timeout=90)
+    np.testing.assert_array_equal(out, np.arange(1_000_000, dtype=np.int64))
+
+
+def test_task_workload_converges_under_pull_and_meta_chaos(chaos_cluster):
+    """put/get + task round trip with chaos on BOTH transfer-plane
+    methods: the remote task pulls the driver's put object (its
+    get_object_meta and pull_object calls eat the injected failures),
+    computes, and the driver pulls the result back."""
+    from ray_tpu._private.rpc import configure_chaos
+
+    rt, _ = chaos_cluster
+
+    payload = np.ones(600_000, dtype=np.float64)  # ~4.8 MB, not inline
+
+    @rt.remote(resources={"remote_node": 1.0})
+    def consume(x):
+        return float(x.sum())
+
+    # Warm one round trip so worker spawn is out of the chaos window.
+    assert rt.get(consume.remote(payload), timeout=90) == 600_000.0
+
+    configure_chaos("pull_object=4,get_object_meta=2")
+    refs = [rt.get(rt.put(payload), timeout=60) for _ in range(2)]
+    for got in refs:
+        assert got.shape == payload.shape
+    total = rt.get(consume.remote(rt.put(2.0 * payload)), timeout=90)
+    assert total == 2.0 * 600_000.0
+
+
+def test_chaos_budget_is_finite_and_clears():
+    """The spec drops exactly the first N calls: once the budget is
+    consumed, the method flows normally again (budget bookkeeping in
+    configure_chaos/_chaos_should_fail). Pure bookkeeping — no
+    cluster needed."""
+    from ray_tpu._private.rpc import _chaos_should_fail, configure_chaos
+
+    try:
+        configure_chaos("some_method=2")
+        assert _chaos_should_fail("some_method")
+        assert _chaos_should_fail("some_method")
+        assert not _chaos_should_fail("some_method")
+        assert not _chaos_should_fail("other_method")
+    finally:
+        configure_chaos("")
+    assert not _chaos_should_fail("some_method")

@@ -189,6 +189,143 @@ def test_iter_batches_sizes(rt_session):
     np.testing.assert_array_equal(np.sort(all_ids), np.arange(100))
 
 
+def _prefetch_threads():
+    import threading
+
+    return [
+        t
+        for t in threading.enumerate()
+        if t.name.startswith("rt-data-prefetch") and t.is_alive()
+    ]
+
+
+def test_iter_batches_prefetch_matches_serial(rt_session):
+    """prefetch_batches=k must be invisible in the output: identical
+    batch boundaries, identical values, identical order vs the serial
+    iterator — for full batches and the drop_last tail alike."""
+    from ray_tpu import data
+
+    def build():
+        return data.range(100, parallelism=3)
+
+    for drop_last in (False, True):
+        serial = list(
+            build().iter_batches(batch_size=32, drop_last=drop_last)
+        )
+        prefetched = list(
+            build().iter_batches(
+                batch_size=32, drop_last=drop_last, prefetch_batches=3
+            )
+        )
+        assert len(serial) == len(prefetched)
+        for s, p in zip(serial, prefetched):
+            np.testing.assert_array_equal(s["id"], p["id"])
+    assert not _prefetch_threads(), "prefetch thread outlived iteration"
+
+
+def test_iter_batches_prefetch_zero_is_serial_path(rt_session):
+    """prefetch_batches=0 must behave exactly like today's iterator:
+    same sizes, same values, and no background thread at all."""
+    from ray_tpu import data
+
+    batches = []
+    for batch in data.range(100, parallelism=3).iter_batches(
+        batch_size=32, prefetch_batches=0
+    ):
+        batches.append(batch)
+        # The serial path never starts a producer thread, even while
+        # the stream is being consumed.
+        assert not _prefetch_threads()
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [32, 32, 32, 4]
+
+
+def test_iter_batches_prefetch_early_break_no_leaks(rt_session):
+    """Breaking out of a prefetching iterator mid-stream must cancel
+    the producer: no leaked rt-data-prefetch threads, and the block
+    get in flight completes instead of dangling."""
+    import time
+
+    from ray_tpu import data
+
+    ds = data.range(400, parallelism=8)
+    seen = []
+    for batch in ds.iter_batches(batch_size=16, prefetch_batches=4):
+        seen.append(batch["id"][0])
+        if len(seen) >= 2:
+            break  # generator close -> producer cancel
+    assert len(seen) == 2
+    deadline = time.time() + 5.0
+    while _prefetch_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not _prefetch_threads(), (
+        f"leaked prefetch threads: {_prefetch_threads()}"
+    )
+    # The session still works after the cancelled stream (no dangling
+    # gets poisoning the runtime).
+    import ray_tpu as rt
+
+    assert rt.get(rt.put(41), timeout=30) == 41
+
+
+def test_iter_batches_prefetch_propagates_udf_error(rt_session):
+    """An exception raised by upstream block tasks must re-raise at
+    the consumer's next(), not vanish into the producer thread."""
+    import pytest as _pytest
+
+    from ray_tpu import data
+
+    def explode(row):
+        if row["id"] == 37:
+            raise ValueError("bad row 37")
+        return row
+
+    ds = data.range(64, parallelism=4).map(explode)
+    with _pytest.raises(Exception, match="bad row 37"):
+        for _ in ds.iter_batches(batch_size=8, prefetch_batches=2):
+            pass
+    assert not _prefetch_threads()
+
+
+def test_streaming_split_iterator_prefetch(rt_session):
+    """DataIterator.iter_batches honours the same prefetch contract
+    (this is the object train workers consume via
+    get_dataset_shard)."""
+    from ray_tpu import data
+
+    ds = data.range(120, parallelism=6)
+    (it,) = ds.streaming_split(1)
+    serial_ids = np.sort(
+        np.concatenate(
+            [
+                b["id"]
+                for b in data.range(120, parallelism=6).iter_batches(
+                    batch_size=25
+                )
+            ]
+        )
+    )
+    pre = list(it.iter_batches(batch_size=25, prefetch_batches=2))
+    got = np.sort(np.concatenate([b["id"] for b in pre]))
+    np.testing.assert_array_equal(got, serial_ids)
+    assert [len(b["id"]) for b in pre] == [25, 25, 25, 25, 20]
+    assert not _prefetch_threads()
+
+
+def test_iter_block_refs_pull_ahead(rt_session):
+    """iter_block_refs(prefetch=n) yields the same refs in the same
+    order as the serial ref stream."""
+    from ray_tpu import data
+
+    import ray_tpu as rt
+
+    ds = data.range(60, parallelism=6).materialize()
+    serial = [rt.get(r) for r in ds.iter_block_refs()]
+    ahead = [rt.get(r) for r in ds.iter_block_refs(prefetch=3)]
+    assert serial == ahead
+    assert not _prefetch_threads()
+
+
 def test_byte_budget_backpressure_skewed_flat_map():
     """Bytes-budget backpressure (reference: _internal/execution/
     backpressure_policy/ resource-based policy): a skewed flat_map
