@@ -11,6 +11,7 @@ from .api import (
     available_resources,
     cancel,
     cluster_resources,
+    diagnose,
     get,
     get_actor,
     get_runtime_context,
@@ -49,6 +50,7 @@ __all__ = [
     "available_resources",
     "timeline",
     "state_summary",
+    "diagnose",
     "ObjectRef",
     "ObjectRefGenerator",
     "ActorClass",

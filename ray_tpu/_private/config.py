@@ -140,6 +140,17 @@ class Config:
     task_events_max_buffer: int = 10000
     #: Whether workers batch task state events to the control plane.
     task_events_enabled: bool = True
+    #: Always-on per-process flight recorder (_private/flight_recorder
+    #: .py): RPC latencies, task begin/end, store put/get, lock waits
+    #: in a bounded ring, pulled lazily by the head / `ray_tpu doctor`.
+    flight_recorder_enabled: bool = True
+    #: Ring capacity (records) of each process's flight recorder.
+    flight_recorder_capacity: int = 4096
+    #: `rt.diagnose()` defaults: a task with no state transition for
+    #: this many seconds counts as hung; a worker whose median step
+    #: time exceeds the cluster p50 by this factor is a straggler.
+    doctor_hung_task_s: float = 60.0
+    doctor_straggler_threshold: float = 1.5
 
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`

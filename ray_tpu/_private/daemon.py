@@ -30,6 +30,8 @@ protocol, src/ray/object_manager/plasma/store.h).
 
 from __future__ import annotations
 
+import bisect
+import math
 import os
 import queue
 import subprocess
@@ -37,6 +39,7 @@ import sys
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
@@ -159,6 +162,84 @@ class ActorRuntime:
     pending: deque = field(default_factory=deque)  # queued while !ALIVE
     inflight: Dict[TaskID, dict] = field(default_factory=dict)
     creation_unpinned: bool = False
+
+
+def _summarize_steps(records: List[dict]) -> dict:
+    """Digest per-step/per-rank phase records into the two views the
+    doctor needs: per-worker step-time stats (straggler detection) and
+    per-step gang skew (max - min step_ms across the ranks that
+    reported that step index).
+
+    Stats are computed over the MOST RECENT job only: mixing two
+    jobs' same-rank records (concurrent tenants, or back-to-back runs
+    within the ring) would yield phantom stragglers and meaningless
+    skew. Older jobs stay in the raw ring (`step_records`); the
+    summary reports how many distinct jobs it saw."""
+    jobs: Dict[str, float] = {}
+    for rec in records:
+        job = str(rec.get("job", ""))
+        t = float(rec.get("time", 0.0))
+        if t >= jobs.get(job, -1.0):
+            jobs[job] = t
+    if len(jobs) > 1:
+        current = max(jobs, key=lambda j: jobs[j])
+        records = [
+            r for r in records if str(r.get("job", "")) == current
+        ]
+    by_step: Dict[int, Dict[int, dict]] = {}
+    by_rank: Dict[int, List[dict]] = {}
+    for rec in records:
+        rank = int(rec.get("rank", 0))
+        by_step.setdefault(int(rec.get("step", 0)), {})[rank] = rec
+        by_rank.setdefault(rank, []).append(rec)
+    skew: Dict[int, float] = {}
+    for step, ranks in by_step.items():
+        # Warmup (first-report) records derive step_ms from a wall
+        # anchored at session construction — setup time, not a step;
+        # ranks differ in setup time, so including them fakes skew.
+        values = [
+            float(r.get("step_ms", 0.0))
+            for r in ranks.values()
+            if not r.get("warmup")
+        ]
+        if len(values) >= 2:
+            skew[step] = round(max(values) - min(values), 3)
+    workers: Dict[int, dict] = {}
+    for rank, recs in by_rank.items():
+        timed = [r for r in recs if not r.get("warmup")] or recs
+        step_ms = sorted(float(r.get("step_ms", 0.0)) for r in timed)
+        row = {
+            # The sample count BEHIND the stats: warmup records are
+            # excluded, so the doctor's `steps >= 3` straggler gate
+            # never convicts on fewer measured steps than it claims.
+            "steps": len(timed),
+            "p50_step_ms": round(step_ms[len(step_ms) // 2], 3),
+            "max_step_ms": round(step_ms[-1], 3),
+            "mean_step_ms": round(sum(step_ms) / len(step_ms), 3),
+        }
+        for phase in ("data_wait_ms", "h2d_ms", "wall_ms"):
+            values = [
+                float(r[phase]) for r in timed if phase in r
+            ]
+            if values:
+                row["mean_" + phase] = round(
+                    sum(values) / len(values), 3
+                )
+        inflight = [
+            int(r["ckpt_inflight"])
+            for r in recs
+            if "ckpt_inflight" in r
+        ]
+        if inflight:
+            row["max_ckpt_inflight"] = max(inflight)
+        workers[rank] = row
+    return {
+        "workers": workers,
+        "skew_ms": skew,
+        "max_skew_ms": max(skew.values(), default=0.0),
+        "steps_observed": len(by_step),
+        "jobs_observed": len(jobs),
+    }
 
 
 class NodeDaemon:
@@ -286,6 +367,18 @@ class NodeDaemon:
         # Finished tracing spans (head only; own ring so span-heavy
         # apps and task-event-heavy apps can't evict each other).
         self._spans: deque = deque(maxlen=config.task_events_max_buffer)
+        # Per-step, per-worker phase records from train telemetry
+        # (head only; ride the metrics pipe as kind="step" records).
+        # Bounded ring: old steps age out, the skew computation only
+        # ever wants the recent window anyway.
+        self._step_records: deque = deque(
+            maxlen=config.task_events_max_buffer
+        )
+        # This process's flight recorder obeys the cluster config
+        # (env RT_flight_recorder_enabled already applied at import).
+        from .flight_recorder import configure as _flight_configure
+
+        _flight_configure(config)
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -313,6 +406,15 @@ class NodeDaemon:
         # (reference: metrics agent aggregation, _private/metrics_agent
         # .py; serving role of the OpenCensus registry).
         self._metrics_table: Dict[str, dict] = {}
+        # (sender, seq) pairs already folded into the table: senders
+        # retry sealed batches until acknowledged, so a batch whose
+        # reply was lost arrives again — applying it twice would
+        # silently inflate every counter it carries. Per sender:
+        # [high-water mark, out-of-order seqs above it] — in-order
+        # delivery keeps the set empty (O(1) resident per sender for
+        # the head's lifetime); only a trim-induced seq gap parks
+        # seqs in the set until the gap is passed.
+        self._metrics_seen: Dict[str, list] = {}
         #: Standing autoscaler capacity target (head only; sdk
         #: request_resources — REPLACE semantics, cleared by []).
         self._resource_requests: List[dict] = []
@@ -374,6 +476,12 @@ class NodeDaemon:
             "metrics_summary",
             "event_stats",
             "profile_worker",
+            # flight recorder / stall doctor (all nodes; diagnose and
+            # step_summary forward to the head)
+            "flight_recorder",
+            "worker_inspect",
+            "step_summary",
+            "diagnose",
             "ping",
             # object data plane (all nodes)
             "pull_object",
@@ -653,7 +761,7 @@ class NodeDaemon:
         # Parked tasks (forward raced a node death, or no feasible node
         # yet) and pending placement groups get another placement
         # attempt on the heartbeat tick.
-        with self._lock:
+        with self._hot_lock("heartbeat"):
             any_parked = bool(self._infeasible)
             any_pending_pg = any(
                 e.state in ("PENDING", "RESCHEDULING")
@@ -3517,11 +3625,36 @@ class NodeDaemon:
             return False
         return True
 
+    @contextmanager
+    def _hot_lock(self, name: str):
+        """self._lock, with the acquisition wait recorded to the
+        flight recorder — used on the hot paths where a long wait IS
+        the diagnosis (dispatch stuck behind a slow handler holding
+        the daemon lock)."""
+        from .flight_recorder import recorder
+
+        rec = recorder()
+        if not rec.enabled:
+            with self._lock:
+                yield
+            return
+        t0 = time.monotonic()
+        with self._lock:
+            waited_ms = (time.monotonic() - t0) * 1e3
+            # Zero-wait acquisitions are the steady state on the
+            # dispatch path — recording them would let thousands of
+            # uninformative entries/s evict the RPC/task events the
+            # doctor digests. A long wait IS the diagnosis; only
+            # those earn a ring slot.
+            if waited_ms >= 1.0:
+                rec.record("lock.wait", name, waited_ms)
+            yield
+
     def _try_dispatch(self, task_id: TaskID, spec: dict) -> bool:
         needs_tpu = spec.get("resources", {}).get("TPU", 0) > 0
         if spec["kind"] == "lease":
             return self._try_grant_lease(task_id, spec, needs_tpu)
-        with self._lock:
+        with self._hot_lock("dispatch"):
             worker = next(
                 (
                     w
@@ -3916,38 +4049,48 @@ class NodeDaemon:
 
         return {"handlers": stats().snapshot()}
 
-    def _h_profile_worker(self, conn, msg):
-        """Attach an on-demand profiler to a live worker (reference:
-        dashboard reporter profile_manager.py py-spy/memray attach;
-        here the worker profiles itself in-process —
-        _private/profiling.py — reached over its direct endpoint).
-        Routing: pid alone targets this node; (node_id, pid) routes
-        driver -> head -> owning daemon. Blocks one RPC pool thread
-        for the profile window (rare, operator-driven)."""
-        pid = msg["pid"]
-        node_id = msg.get("node_id")
-        fwd = {
-            k: msg[k]
-            for k in ("pid", "kind", "duration_s", "hz", "top")
-            if k in msg
-        }
-        timeout = float(msg.get("duration_s", 5.0)) + 30.0
-        if node_id and node_id != self.node_id.binary():
-            if not self.is_head:
-                return self.head.call(
-                    "profile_worker",
-                    timeout=timeout,
-                    node_id=node_id,
-                    **fwd,
-                )
-            client = self._node_client(node_id)
-            if client is None:
-                raise ValueError(
-                    f"no live node {NodeID(node_id).hex()}"
-                )
-            return client.call(
-                "profile_worker", timeout=timeout, **fwd
+    def _relay_to_node(
+        self, method: str, node_id, timeout: float, **fwd
+    ) -> Optional[dict]:
+        """Shared routing step of the operator RPCs that target a
+        worker/daemon by node (profile_worker, flight_recorder,
+        worker_inspect): a non-head daemon bounces the call through
+        the head, the head calls the owning daemon directly. Returns
+        None when `node_id` is absent or THIS node — the caller
+        serves the request locally."""
+        if not node_id or node_id == self.node_id.binary():
+            return None
+        if not self.is_head:
+            return self.head.call(
+                method, timeout=timeout, node_id=node_id, **fwd
             )
+        client = self._node_client(node_id)
+        if client is None:
+            raise ValueError(f"no live node {NodeID(node_id).hex()}")
+        return client.call(method, timeout=timeout, **fwd)
+
+    @staticmethod
+    def _parallel_map(fn, items: list) -> list:
+        """Bounded concurrent map for the operator-driven fan-outs
+        (inspect probes, diagnose node pulls, stack captures): one
+        slow or unreachable target costs ONE probe window for the
+        whole sweep instead of serializing every target behind it —
+        several wedged targets in a serial loop would blow the
+        caller's own RPC timeout exactly when the doctor is needed."""
+        if not items:
+            return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(8, len(items))
+        ) as pool:
+            return list(pool.map(fn, items))
+
+    def _call_worker_direct(
+        self, pid: int, method: str, timeout: float, **kwargs
+    ) -> dict:
+        """Call a LOCAL worker's direct endpoint by pid (the other
+        shared half of the operator-RPC relay)."""
         with self._lock:
             worker = next(
                 (
@@ -3960,22 +4103,44 @@ class NodeDaemon:
         if worker is None:
             raise ValueError(
                 f"no local worker with pid {pid} (pass node_id to "
-                f"profile a worker on another node)"
+                f"reach a worker on another node)"
             )
         client = RpcClient(worker.direct_address)
         try:
-            return client.call(
-                "profile",
-                timeout=timeout,
-                kind=msg.get("kind", "stack"),
-                **{
-                    k: msg[k]
-                    for k in ("duration_s", "hz", "top")
-                    if k in msg
-                },
-            )
+            return client.call(method, timeout=timeout, **kwargs)
         finally:
             client.close()
+
+    def _h_profile_worker(self, conn, msg):
+        """Attach an on-demand profiler to a live worker (reference:
+        dashboard reporter profile_manager.py py-spy/memray attach;
+        here the worker profiles itself in-process —
+        _private/profiling.py — reached over its direct endpoint).
+        Routing: pid alone targets this node; (node_id, pid) routes
+        driver -> head -> owning daemon. Blocks one RPC pool thread
+        for the profile window (rare, operator-driven)."""
+        fwd = {
+            k: msg[k]
+            for k in ("pid", "kind", "duration_s", "hz", "top")
+            if k in msg
+        }
+        timeout = float(msg.get("duration_s", 5.0)) + 30.0
+        reply = self._relay_to_node(
+            "profile_worker", msg.get("node_id"), timeout, **fwd
+        )
+        if reply is not None:
+            return reply
+        return self._call_worker_direct(
+            msg["pid"],
+            "profile",
+            timeout,
+            kind=msg.get("kind", "stack"),
+            **{
+                k: msg[k]
+                for k in ("duration_s", "hz", "top")
+                if k in msg
+            },
+        )
 
     def _h_list_task_events(self, conn, msg):
         if not self.is_head:
@@ -4154,42 +4319,186 @@ class NodeDaemon:
         head's aggregate table (reference: core-worker metrics flow to
         the node's metrics agent, then get scraped centrally)."""
         if not self.is_head:
-            try:
-                return self.head.call(
-                    "metrics_record", records=msg["records"]
-                )
-            except RpcError:
-                return {}
+            # A failed forward must FAIL the worker's call: replying
+            # success here would defeat the sender-side requeue (the
+            # _Buffer keeps the batch and retries) and silently lose
+            # the records — step telemetry among them. Bounded: an
+            # unresponsive head must not pin this daemon's pool
+            # threads (one per flushing worker, every 0.5 s) until
+            # the node itself stops answering dispatch/heartbeat.
+            return self.head.call(
+                "metrics_record",
+                records=msg["records"],
+                sender=msg.get("sender"),
+                seq=msg.get("seq"),
+                timeout=30.0,
+            )
         with self._lock:
-            for kind, name, value, tags in msg["records"]:
-                tags = tuple(tuple(t) for t in tags)
-                entry = self._metrics_table.setdefault(
-                    name,
-                    {"kind": kind, "by_tags": {}},
-                )
-                for bucket in (
-                    entry,
-                    entry["by_tags"].setdefault(
-                        tags,
-                        {},
-                    ),
-                ):
-                    if kind == "counter":
-                        bucket["total"] = (
-                            bucket.get("total", 0.0) + value
-                        )
-                    elif kind == "gauge":
-                        bucket["value"] = value
-                    else:  # histogram
-                        bucket["count"] = bucket.get("count", 0) + 1
-                        bucket["sum"] = bucket.get("sum", 0.0) + value
-                        bucket["min"] = min(
-                            bucket.get("min", value), value
-                        )
-                        bucket["max"] = max(
-                            bucket.get("max", value), value
-                        )
+            sender, seq = msg.get("sender"), msg.get("seq")
+            entry = None
+            if sender is not None and seq is not None:
+                sender = str(sender)
+                seq = int(seq)
+                entry = self._metrics_seen.pop(sender, None)
+                if entry is None:
+                    entry = [0, set()]
+                # Re-insert at the END: eviction below pops the
+                # LEAST-RECENTLY-USED sender, never one still
+                # actively flushing (evicting an active sender would
+                # re-enable the redelivery double-count this entry
+                # exists to prevent).
+                self._metrics_seen[sender] = entry
+                if seq <= entry[0] or seq in entry[1]:
+                    # Redelivery of a batch whose reply was lost —
+                    # already folded in, ack without re-applying.
+                    return {}
+                while len(self._metrics_seen) > 4096:
+                    self._metrics_seen.pop(
+                        next(iter(self._metrics_seen))
+                    )
+            for rec in msg["records"]:
+                try:
+                    self._apply_metric_record(rec)
+                except Exception as e:
+                    # A malformed record (e.g. a hand-rolled
+                    # report_step extra whose items aren't
+                    # 2-tuples) can never succeed on a retry:
+                    # skipping it — visibly, via this ring — is the
+                    # only option that neither wedges the sender's
+                    # requeue loop nor loses the good records
+                    # around it.
+                    from .flight_recorder import record as _fr
+
+                    _fr(
+                        "metrics.drop",
+                        type(e).__name__,
+                        0.0,
+                        {"error": True, "detail": str(e)[:200]},
+                    )
+            # Seal the seq only now, with the batch folded in:
+            # marking it seen before applying would turn a crash
+            # mid-batch into silent permanent loss (the sender's
+            # retry of the partially-applied batch would be dropped
+            # as a duplicate). Then compact: senders deliver sealed
+            # batches in seq order, so the contiguous prefix
+            # collapses into the high-water mark and steady state
+            # keeps nothing resident per sender but two ints.
+            if entry is not None:
+                wm, seen = entry[0], entry[1]
+                seen.add(seq)
+                while wm + 1 in seen:
+                    wm += 1
+                    seen.discard(wm)
+                entry[0] = wm
+                if len(seen) > 4096:
+                    seen.discard(min(seen))
         return {}
+
+    def _apply_metric_record(self, rec) -> None:
+        """Fold ONE metrics-pipe record into the head's tables
+        (caller holds self._lock)."""
+        kind, name, value, tags = rec[:4]
+        if kind == "step":
+            # Train-step telemetry rides the metrics pipe as
+            # its own record kind: `tags` carries the phase
+            # payload (train/telemetry.py), `value` the step
+            # index. Stored whole — skew needs per-step,
+            # per-rank records, not aggregates.
+            self._step_records.append(
+                {
+                    "step": int(value),
+                    "time": time.time(),
+                    **{str(k): v for k, v in tags},
+                }
+            )
+            return
+        declared = tuple(rec[4]) if len(rec) > 4 else ()
+        tags = tuple(tuple(t) for t in tags)
+        entry = self._metrics_table.setdefault(
+            name,
+            {"kind": kind, "by_tags": {}},
+        )
+        if declared and "boundaries" not in entry:
+            entry["boundaries"] = declared
+        # First-seen boundaries win for BOTH bucketing and
+        # labels: a same-named histogram re-declared with
+        # different boundaries still lands in one
+        # consistently-labeled set of buckets.
+        boundaries = entry.get("boundaries", ())
+        for bucket in (
+            entry,
+            entry["by_tags"].setdefault(
+                tags,
+                {},
+            ),
+        ):
+            if kind == "counter":
+                bucket["total"] = (
+                    bucket.get("total", 0.0) + value
+                )
+            elif kind == "gauge":
+                bucket["value"] = value
+            else:  # histogram
+                self._observe_histogram(
+                    bucket, value, boundaries
+                )
+
+    @staticmethod
+    def _observe_histogram(
+        bucket: dict, value: float, boundaries: tuple
+    ) -> None:
+        """Fold one observation into a histogram aggregate: running
+        count/sum/min/max, Prometheus-style cumulative-le bucket
+        counts against the metric's declared boundaries, and a bounded
+        sample reservoir (last 1024) for p50/p95/p99 at summary time
+        (underscore keys are internal; metrics_summary strips them)."""
+        bucket["count"] = bucket.get("count", 0) + 1
+        bucket["sum"] = bucket.get("sum", 0.0) + value
+        bucket["min"] = min(bucket.get("min", value), value)
+        bucket["max"] = max(bucket.get("max", value), value)
+        samples = bucket.get("_samples")
+        if samples is None:
+            samples = bucket["_samples"] = deque(maxlen=1024)
+        samples.append(value)
+        if boundaries:
+            counts = bucket.get("_bucket_counts")
+            if counts is None or len(counts) != len(boundaries) + 1:
+                counts = bucket["_bucket_counts"] = [0] * (
+                    len(boundaries) + 1
+                )
+            counts[bisect.bisect_left(boundaries, value)] += 1
+
+    @staticmethod
+    def _finish_histogram(bucket: dict, boundaries: tuple) -> dict:
+        """Wire/user view of a histogram aggregate: percentiles from
+        the sample reservoir + named bucket counts; internal keys
+        dropped."""
+        out = {
+            k: v for k, v in bucket.items() if not k.startswith("_")
+        }
+        samples = bucket.get("_samples")
+        if samples:
+            ordered = sorted(samples)
+            n = len(ordered)
+
+            def pct(p: float) -> float:
+                return ordered[
+                    min(n - 1, max(0, math.ceil(p * n) - 1))
+                ]
+
+            out["p50"] = pct(0.50)
+            out["p95"] = pct(0.95)
+            out["p99"] = pct(0.99)
+        counts = bucket.get("_bucket_counts")
+        if boundaries and counts:
+            named = {}
+            running = 0
+            for bound, c in zip(boundaries, counts):
+                running += c
+                named[f"le_{bound:g}"] = running
+            named["inf"] = running + counts[-1]
+            out["buckets"] = named
+        return out
 
     def _h_metrics_summary(self, conn, msg):
         if not self.is_head:
@@ -4197,11 +4506,21 @@ class NodeDaemon:
         with self._lock:
             out = {}
             for name, entry in self._metrics_table.items():
+                boundaries = entry.get("boundaries", ())
+                if entry.get("kind") == "histogram":
+                    fmt = lambda b: self._finish_histogram(  # noqa: E731
+                        b, boundaries
+                    )
+                else:
+                    fmt = dict
                 clean = {
-                    k: v for k, v in entry.items() if k != "by_tags"
+                    k: v
+                    for k, v in fmt(entry).items()
+                    if k != "by_tags"
                 }
                 clean["by_tags"] = {
-                    "|".join(f"{k}={v}" for k, v in tags): dict(bucket)
+                    "|".join(f"{k}={v}" for k, v in tags):
+                    fmt(bucket)
                     for tags, bucket in entry["by_tags"].items()
                 }
                 out[name] = clean
@@ -4216,7 +4535,7 @@ class NodeDaemon:
         )
 
         core_by_node = {self.node_id.hex(): collect(self)}
-        for info in self.control.nodes.values():
+        for info in self.control.all_nodes():
             if info.is_head or not info.alive:
                 continue
             if info.core_metrics:
@@ -4315,6 +4634,423 @@ class NodeDaemon:
         limit = int(msg.get("limit", 1000))
         with self._lock:
             return {"spans": list(self._spans)[-limit:]}
+
+    # ------------------------------------------------------------------
+    # flight recorder / stall doctor
+    # ------------------------------------------------------------------
+    def _h_flight_recorder(self, conn, msg):
+        """Pull a flight-recorder ring. No routing args: THIS
+        process's ring. `pid` alone: a local worker's ring (over its
+        direct endpoint). (`node_id`, [`pid`]): routed driver -> head
+        -> owning daemon, mirroring profile_worker. Rings are only
+        ever pulled — steady-state recording cost stays one deque
+        append per event."""
+        from .flight_recorder import recorder
+
+        fwd = {
+            k: msg[k] for k in ("limit", "kinds", "pid") if k in msg
+        }
+        reply = self._relay_to_node(
+            "flight_recorder", msg.get("node_id"), 30.0, **fwd
+        )
+        if reply is not None:
+            return reply
+        pid = msg.get("pid")
+        if pid and pid != os.getpid():
+            return self._call_worker_direct(
+                pid,
+                "flight_recorder",
+                10.0,
+                **{
+                    k: msg[k] for k in ("limit", "kinds") if k in msg
+                },
+            )
+        rec = recorder()
+        return {
+            "pid": os.getpid(),
+            "node_id": self.node_id.binary(),
+            "records": rec.snapshot(
+                limit=msg.get("limit", 0), kinds=msg.get("kinds")
+            ),
+            "summary": rec.summary(),
+        }
+
+    def _h_worker_inspect(self, conn, msg):
+        """Current in-flight tasks of every local worker (with
+        `node_id`: of another node's workers), pulled from each
+        worker's `inspect` direct endpoint. The doctor's hung-task
+        source: direct-transport tasks report state events only at
+        completion, so an in-flight hang is visible ONLY here."""
+        reply = self._relay_to_node(
+            "worker_inspect", msg.get("node_id"), 30.0
+        )
+        if reply is not None:
+            return reply
+        with self._lock:
+            targets = [
+                (w.pid, w.direct_address)
+                for w in self.workers.values()
+            ]
+
+        def probe(target) -> dict:
+            pid, addr = target
+            row: dict = {"pid": pid, "node_id": self.node_id.binary()}
+            if addr:
+                try:
+                    client = RpcClient(addr, connect_timeout=2.0)
+                    try:
+                        reply = client.call("inspect", timeout=5.0)
+                    finally:
+                        client.close()
+                    row["inflight"] = reply.get("inflight", [])
+                    row["queued"] = reply.get("queued", 0)
+                except RpcError as e:
+                    # Only a worker STILL registered after the failed
+                    # probe is a finding — one that deregistered in
+                    # between (idle reap, pool churn) hit a normal
+                    # lifecycle race, not a hang.
+                    with self._lock:
+                        still_registered = any(
+                            w.pid == pid
+                            and w.direct_address == addr
+                            for w in self.workers.values()
+                        )
+                    if still_registered:
+                        row["error"] = str(e)
+                    else:
+                        row["exited"] = True
+            return row
+
+        return {"workers": self._parallel_map(probe, targets)}
+
+    def _h_step_summary(self, conn, msg):
+        """Gang-step telemetry digest (head): per-worker step-time
+        stats and per-step skew (max - min step_ms across workers of
+        the same step index) — the number that says WHICH worker the
+        gang is waiting on (PAPERS: Podracer gang-step skew)."""
+        if not self.is_head:
+            return self.head.call(
+                "step_summary",
+                limit=msg.get("limit", 1000),
+                records=msg.get("records", False),
+            )
+        limit = int(msg.get("limit", 1000))
+        with self._lock:
+            records = list(self._step_records)[-limit:]
+        reply = {"summary": _summarize_steps(records)}
+        if msg.get("records"):
+            # Raw per-step dicts are opt-in: summary readers (the
+            # dashboard's steady-state poll among them) shouldn't pay
+            # for up to `limit` records they discard.
+            reply["records"] = records
+        return reply
+
+    def _h_diagnose(self, conn, msg):
+        """Stall doctor: fold head task state, per-worker in-flight
+        views, step telemetry, and flight-recorder digests into one
+        verdict — stragglers (median step time > cluster p50 x
+        threshold), hung tasks (in flight / RUNNING past a deadline,
+        with the offender's stack auto-captured through the profile
+        relay), and dead nodes. Served by the head; operator-driven,
+        so the cluster-wide pulls happen HERE, never in steady
+        state."""
+        if not self.is_head:
+            fwd = {
+                k: msg[k]
+                for k in (
+                    "hung_task_s",
+                    "straggler_threshold",
+                    "capture_stacks",
+                    "limit",
+                )
+                if k in msg
+            }
+            return self.head.call("diagnose", timeout=120.0, **fwd)
+        hung_s = float(
+            msg.get("hung_task_s", self.config.doctor_hung_task_s)
+        )
+        threshold = float(
+            msg.get(
+                "straggler_threshold",
+                self.config.doctor_straggler_threshold,
+            )
+        )
+        capture = bool(msg.get("capture_stacks", True))
+        now = time.time()
+        problems: list = []
+
+        # Dead nodes first: everything else is noise if the gang lost
+        # a member.
+        for info in self.control.all_nodes():
+            if not info.alive:
+                problems.append(
+                    {
+                        "kind": "dead_node",
+                        "node_id": info.node_id.hex(),
+                        "detail": (
+                            f"node {info.node_id.hex()[:12]} stopped "
+                            "heartbeating"
+                        ),
+                    }
+                )
+
+        # Stragglers from step telemetry — same default window as
+        # step_summary, so the two surfaces agree on the same
+        # cluster (the full 10k ring would keep convicting a worker
+        # that was slow thousands of steps ago and has recovered).
+        limit = int(msg.get("limit", 1000))
+        with self._lock:
+            step_records = list(self._step_records)[-limit:]
+        steps = _summarize_steps(step_records)
+        workers = steps.get("workers", {})
+        if len(workers) >= 2:
+            medians = sorted(
+                w["p50_step_ms"] for w in workers.values()
+            )
+            # LOWER median: with an even worker count the upper
+            # median is the straggler's own time (2 workers: the slow
+            # one could never exceed threshold x itself).
+            cluster_p50 = medians[(len(medians) - 1) // 2]
+            for rank in sorted(workers):
+                w = workers[rank]
+                if (
+                    cluster_p50 > 0
+                    and w["steps"] >= 3
+                    and w["p50_step_ms"] > threshold * cluster_p50
+                ):
+                    problems.append(
+                        {
+                            "kind": "straggler",
+                            "rank": rank,
+                            "p50_step_ms": w["p50_step_ms"],
+                            "cluster_p50_ms": round(cluster_p50, 3),
+                            "ratio": round(
+                                w["p50_step_ms"] / cluster_p50, 2
+                            ),
+                            "detail": (
+                                f"worker rank {rank} median step "
+                                f"{w['p50_step_ms']:.1f} ms vs "
+                                f"cluster p50 {cluster_p50:.1f} ms "
+                                f"(x{w['p50_step_ms'] / cluster_p50:.1f}"
+                                f" > x{threshold:g} threshold)"
+                            ),
+                        }
+                    )
+
+        # Hung tasks, source 1: live in-flight views pulled from every
+        # worker on every node.
+        inspects: list = []
+        ring_digests: dict = {}
+        try:
+            inspects.extend(
+                self._h_worker_inspect(conn, {})["workers"]
+            )
+        except Exception as e:  # noqa: BLE001 — folded into verdict
+            # A head that cannot inspect its own workers is itself a
+            # finding — the verdict reports it rather than dying.
+            problems.append(
+                {
+                    "kind": "unreachable_node",
+                    "node_id": self.node_id.hex(),
+                    "detail": f"head worker inspect failed: {e!r}",
+                }
+            )
+        from .flight_recorder import recorder as _fr
+
+        ring_digests[self.node_id.hex()] = _fr().summary()
+        remote = []
+        for info in self.control.alive_nodes():
+            nid = info.node_id.binary()
+            if nid == self.node_id.binary():
+                continue
+            client = self._node_client(nid)
+            if client is not None:
+                remote.append((info.node_id.hex(), client))
+
+        def pull_node(target):
+            # A node's two calls run sequentially on its own
+            # (dedicated) client; nodes pull concurrently.
+            node_hex, client = target
+            try:
+                workers = client.call(
+                    "worker_inspect", timeout=30.0
+                )["workers"]
+                summary = client.call(
+                    "flight_recorder", timeout=15.0, limit=1
+                )["summary"]
+                return node_hex, workers, summary, None
+            except RpcError as e:
+                return node_hex, [], None, str(e)
+
+        for node_hex, workers, summary, err in self._parallel_map(
+            pull_node, remote
+        ):
+            if err is not None:
+                problems.append(
+                    {
+                        "kind": "unreachable_node",
+                        "node_id": node_hex,
+                        "detail": f"inspect failed: {err}",
+                    }
+                )
+                continue
+            inspects.extend(workers)
+            ring_digests[node_hex] = summary
+        # A task that reported step telemetry within the deadline is
+        # making progress — a long-lived in-flight train loop, not a
+        # hang (a gang fit task runs ONE task for the whole job;
+        # flagging it would page on every healthy run). Keyed by TASK
+        # id where the record carries one, so a concurrent actor's
+        # OTHER, genuinely wedged call is still caught; (node, pid)
+        # only covers records from outside any task (hand-rolled
+        # loops).
+        progressing_tasks: set = set()
+        progressing_procs: set = set()
+        for rec in step_records:
+            if float(rec.get("time", 0.0)) < now - hung_s:
+                continue
+            if rec.get("task"):
+                progressing_tasks.add(str(rec["task"]))
+            elif rec.get("pid") is not None:
+                progressing_procs.add(
+                    (str(rec.get("node", "")), int(rec["pid"]))
+                )
+        to_capture: list = []
+        for row in inspects:
+            if row.get("error"):
+                problems.append(
+                    {
+                        "kind": "unresponsive_worker",
+                        "pid": row["pid"],
+                        "node_id": NodeID(row["node_id"]).hex(),
+                        "detail": (
+                            f"worker pid {row['pid']} did not answer "
+                            f"inspect: {row['error']}"
+                        ),
+                    }
+                )
+                continue
+            proc_progressing = (
+                NodeID(row["node_id"]).hex(),
+                row["pid"],
+            ) in progressing_procs
+            for task in row.get("inflight", []):
+                if task.get("age_s", 0.0) <= hung_s:
+                    continue
+                if (
+                    proc_progressing
+                    or task["task_id"] in progressing_tasks
+                ):
+                    continue
+                problem = {
+                    "kind": "hung_task",
+                    "task_id": task["task_id"],
+                    "name": task.get("name", ""),
+                    "age_s": task["age_s"],
+                    "pid": row["pid"],
+                    "node_id": NodeID(row["node_id"]).hex(),
+                    "detail": (
+                        f"task {task.get('name') or task['task_id'][:12]}"
+                        f" has run {task['age_s']:.1f}s on pid "
+                        f"{row['pid']} (> {hung_s:g}s deadline)"
+                    ),
+                }
+                if capture:
+                    to_capture.append((problem, row))
+                problems.append(problem)
+        if to_capture:
+            # Auto-capture every offender's stacks through the
+            # existing profile relay — the dump an operator would ask
+            # for next, taken while it still shows the hang.
+            def capture_stack(target):
+                problem, row = target
+                try:
+                    reply = self._h_profile_worker(
+                        conn,
+                        {
+                            "pid": row["pid"],
+                            "node_id": row["node_id"],
+                            "kind": "stack",
+                        },
+                    )
+                    problem["stack"] = reply.get("stacks", "")
+                except Exception as e:  # noqa: BLE001 — verdict survives
+                    problem["stack_error"] = repr(e)
+
+            self._parallel_map(capture_stack, to_capture)
+
+        # Hung tasks, source 2: the head event stream — catches
+        # daemon-scheduled tasks whose RUNNING event landed at
+        # dispatch but whose worker stopped reporting. Tasks visible
+        # in ANY live worker's in-flight view were already judged by
+        # source 1 (deadline + step-progress exemption) — source 2
+        # only fires for RUNNING tasks NO reachable worker claims,
+        # a premise that only holds when EVERY node was probed and
+        # answered: with a failed probe, an unreachable node, or a
+        # DEAD node (its workers were never probed at all — a task
+        # last seen RUNNING there is lost with it, not hung) the
+        # unclaimed task may simply live behind the gap (already
+        # reported as its own problem), and task events carry no
+        # node/pid to tell.
+        view_complete = not any(
+            row.get("error") for row in inspects
+        ) and not any(
+            p["kind"] in ("unreachable_node", "dead_node")
+            for p in problems
+        )
+        seen = {
+            p["task_id"]
+            for p in problems
+            if p["kind"] == "hung_task"
+        }
+        seen.update(
+            task["task_id"]
+            for row in inspects
+            if not row.get("error")
+            for task in row.get("inflight", [])
+        )
+        latest: dict = {}
+        for event in self.control.list_task_events(10000):
+            latest[event["task_id"]] = event
+        for tid, event in latest.items():
+            if (
+                not view_complete
+                or event["state"] != "RUNNING"
+                or tid in seen
+                or now - event["time"] <= hung_s
+            ):
+                continue
+            problems.append(
+                {
+                    "kind": "hung_task",
+                    "task_id": tid,
+                    "name": event.get("name", ""),
+                    "age_s": round(now - event["time"], 1),
+                    "detail": (
+                        f"task {event.get('name') or tid[:12]} has "
+                        f"been RUNNING {now - event['time']:.1f}s "
+                        "with no further state transition"
+                    ),
+                }
+            )
+
+        summary = self.control.summary()
+        return {
+            "verdict": {
+                "healthy": not problems,
+                "problems": problems,
+                "steps": steps,
+                "rpc": ring_digests,
+                "nodes": {
+                    "total": summary["nodes"],
+                    "alive": summary["alive_nodes"],
+                },
+                "params": {
+                    "hung_task_s": hung_s,
+                    "straggler_threshold": threshold,
+                },
+            }
+        }
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if state == "RETRY":

@@ -270,6 +270,13 @@ class ControlState:
         with self._lock:
             return [n for n in self.nodes.values() if n.alive]
 
+    def all_nodes(self) -> List[NodeInfo]:
+        """Snapshot of every known node, dead ones included — readers
+        must not iterate `self.nodes` bare (registration on another
+        thread would resize the dict mid-iteration)."""
+        with self._lock:
+            return list(self.nodes.values())
+
     # ---- jobs ----
     def next_job_id(self) -> JobID:
         with self._lock:

@@ -60,6 +60,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from .event_stats import stats as _event_stats
+from .flight_recorder import recorder as _flight
 from .wire import (
     PROTOCOL_VERSION,
     ProtocolVersionError,
@@ -762,16 +763,26 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001 — errors propagate to caller
             import traceback
 
-            _event_stats().record(
-                method, queue_s, time.monotonic() - t_start, error=True
+            exec_s = time.monotonic() - t_start
+            _event_stats().record(method, queue_s, exec_s, error=True)
+            _flight().record(
+                "rpc.server",
+                method,
+                exec_s * 1e3,
+                {"queue_ms": round(queue_s * 1e3, 3), "error": True},
             )
             if mid:
                 conn.reply(
                     mid, {"_error": f"{e}\n{traceback.format_exc()}"}
                 )
             return
-        _event_stats().record(
-            method, queue_s, time.monotonic() - t_start
+        exec_s = time.monotonic() - t_start
+        _event_stats().record(method, queue_s, exec_s)
+        _flight().record(
+            "rpc.server",
+            method,
+            exec_s * 1e3,
+            {"queue_ms": round(queue_s * 1e3, 3)} if queue_s else None,
         )
         if result is not DEFERRED and mid:
             conn.reply(mid, result or {})
@@ -1177,6 +1188,21 @@ class RpcClient:
             raise RpcError(f"{method}: {err}")
 
     def _call_once(self, method, timeout, kwargs) -> dict:
+        rec = _flight()
+        if rec.enabled:
+            t0 = time.monotonic()
+            reply = self._call_once_inner(method, timeout, kwargs)
+            err = reply.get("_error")
+            rec.record(
+                "rpc.client",
+                method,
+                (time.monotonic() - t0) * 1e3,
+                {"error": True} if err is not None else None,
+            )
+            return reply
+        return self._call_once_inner(method, timeout, kwargs)
+
+    def _call_once_inner(self, method, timeout, kwargs) -> dict:
         with self._lock:
             if self._closed:
                 return {"_error": "__connection_lost__"}
@@ -1224,6 +1250,21 @@ class RpcClient:
             # handle that for the closed-client path).
             callback({"_error": "__chaos_injected_failure__"})
             return
+        rec = _flight()
+        if rec.enabled:
+            t0 = time.monotonic()
+            inner = callback
+
+            def callback(reply, _inner=inner, _t0=t0):  # noqa: F811
+                rec.record(
+                    "rpc.client",
+                    method,
+                    (time.monotonic() - _t0) * 1e3,
+                    {"error": True}
+                    if reply.get("_error") is not None
+                    else None,
+                )
+                _inner(reply)
         with self._lock:
             if self._closed:
                 callback({"_error": "__connection_lost__"})

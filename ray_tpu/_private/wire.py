@@ -244,9 +244,25 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "list_objects": {"?limit": int},
     "cluster_load": {},
     "request_resources": {"bundles": list},
-    "metrics_record": {"records": list},
+    "metrics_record": {
+        "records": list,
+        "?sender": (str, type(None)),
+        "?seq": (int, type(None)),
+    },
     "metrics_summary": {},
     "event_stats": {},
+    # flight recorder / doctor (rings are pulled, never pushed)
+    "flight_recorder": {
+        "?limit": int, "?kinds": (list, type(None)),
+        "?pid": int, "?node_id": (bytes, type(None)),
+    },
+    "inspect": {},
+    "worker_inspect": {"?node_id": (bytes, type(None))},
+    "step_summary": {"?limit": int, "?records": bool},
+    "diagnose": {
+        "?hung_task_s": _num, "?straggler_threshold": _num,
+        "?capture_stacks": bool, "?limit": int,
+    },
     # pubsub / log streaming
     "subscribe_logs": {"?channels": list},
     "unsubscribe_logs": {},

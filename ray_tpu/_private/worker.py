@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import exceptions as exc
 from ..object_ref import ObjectRef
 from .config import Config
+from .flight_recorder import recorder as _flight
 from .function_manager import FunctionManager
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import ObjectStoreFullError, make_store
@@ -135,6 +136,11 @@ class CoreWorker:
         # reader thread: the daemon may push execute_task immediately
         # after (even before) the register reply.
         self._task_queue: "queue.Queue" = queue.Queue()
+        #: task_id hex -> {name, kind, started} for every task this
+        #: process is executing right now (concurrent actors may hold
+        #: several). Read by the `inspect` direct handler — the
+        #: doctor's pull-based hung-task scan.
+        self._inflight_tasks: Dict[str, dict] = {}
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
         self._actor_pg_context: Optional[dict] = None
@@ -195,6 +201,45 @@ class CoreWorker:
                 return DEFERRED
 
             self._direct_server.register("profile", _h_profile)
+
+            def _h_inspect(conn, msg):
+                # Pull-based liveness introspection: what is THIS
+                # worker executing right now, and for how long? The
+                # doctor's hung-task scan reads this instead of the
+                # task-event stream (direct-transport tasks report
+                # events only at completion — an in-flight hang is
+                # invisible there by design).
+                now = time.time()
+                return {
+                    "pid": os.getpid(),
+                    "inflight": [
+                        dict(
+                            info,
+                            age_s=round(now - info["started"], 3),
+                        )
+                        for info in list(
+                            self._inflight_tasks.values()
+                        )
+                    ],
+                    "queued": self._task_queue.qsize(),
+                }
+
+            self._direct_server.register("inspect", _h_inspect)
+
+            def _h_flight_recorder(conn, msg):
+                rec = _flight()
+                return {
+                    "pid": os.getpid(),
+                    "records": rec.snapshot(
+                        limit=msg.get("limit", 0),
+                        kinds=msg.get("kinds"),
+                    ),
+                    "summary": rec.summary(),
+                }
+
+            self._direct_server.register(
+                "flight_recorder", _h_flight_recorder
+            )
             self._direct_server.start()
         self._direct_task_counts = {
             "lock": threading.Lock(),
@@ -225,6 +270,9 @@ class CoreWorker:
         )
         self.node_id = NodeID(reply["node_id"])
         self.config = Config(**reply["config"])
+        from .flight_recorder import configure as _flight_configure
+
+        _flight_configure(self.config)
         if role == "driver":
             self.job_id = JobID(reply["job_id"])
             self.worker_id = WorkerID.from_random()
@@ -424,6 +472,34 @@ class CoreWorker:
     def put_object(
         self, oid: ObjectID, value: Any, cache: bool = False
     ) -> Tuple[str, Any]:
+        rec = _flight()
+        if not rec.enabled:
+            return self._put_object_inner(oid, value, cache)
+        t0 = time.monotonic()
+        try:
+            kind, payload = self._put_object_inner(oid, value, cache)
+        except BaseException:
+            # A failed write (store full, serialization error) is
+            # exactly the event the ring exists to keep — same
+            # discipline as _get_one.
+            rec.record(
+                "store.put",
+                "put",
+                (time.monotonic() - t0) * 1e3,
+                {"error": True},
+            )
+            raise
+        rec.record(
+            "store.put",
+            kind,
+            (time.monotonic() - t0) * 1e3,
+            {"bytes": len(payload) if kind == "inline" else payload},
+        )
+        return kind, payload
+
+    def _put_object_inner(
+        self, oid: ObjectID, value: Any, cache: bool = False
+    ) -> Tuple[str, Any]:
         """Serialize and store; returns ("inline", bytes) or ("shm", size).
 
         `cache=True` (explicit put(): an ObjectRef will hold a local
@@ -464,6 +540,39 @@ class CoreWorker:
         return out
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        rec = _flight()
+        if not rec.enabled:
+            return self._get_one_inner(oid, timeout)
+        with self._ref_lock:
+            cached = self._inline_cache.get(oid)
+        if cached is not None:
+            # Inline-cache hits are sub-microsecond and arrive
+            # thousands per second after a fan-out — recording each
+            # would evict the diagnostic events the ring exists to
+            # keep (same discipline as the daemon's zero-wait lock
+            # acquisitions). Resolved right here so the hot path pays
+            # ONE lock acquisition, not a probe plus the inner
+            # lookup.
+            return self.serialization.deserialize(cached)
+        t0 = time.monotonic()
+        try:
+            value = self._get_one_inner(oid, timeout)
+        except BaseException:
+            rec.record(
+                "store.get",
+                "fetch",
+                (time.monotonic() - t0) * 1e3,
+                {"error": True},
+            )
+            raise
+        rec.record(
+            "store.get", "fetch", (time.monotonic() - t0) * 1e3
+        )
+        return value
+
+    def _get_one_inner(
+        self, oid: ObjectID, timeout: Optional[float]
+    ) -> Any:
         deadline = None if timeout is None else time.time() + timeout
         with self._ref_lock:
             cached = self._inline_cache.get(oid)
@@ -1171,6 +1280,13 @@ class CoreWorker:
     def _execute(self, spec: dict, reply_to=None) -> None:
         start_time = time.time()
         task_id = TaskID(spec["task_id"])
+        self._inflight_tasks[task_id.hex()] = {
+            "task_id": task_id.hex(),
+            "name": spec.get("name", ""),
+            "kind": spec.get("kind", "normal"),
+            "started": start_time,
+        }
+        task_failed = False
         self._ctx.task_id = task_id
         self._ctx.put_index = 0
         self._ctx.submit_index = 0
@@ -1286,6 +1402,7 @@ class CoreWorker:
                 from ..util.tracing import add_span_attributes
 
                 add_span_attributes(error=repr(e))
+            task_failed = True
             payload = make_exception_payload(e)
             if reply_to is not None:
                 # Events before the reply: a state/timeline query
@@ -1303,6 +1420,17 @@ class CoreWorker:
         finally:
             if trace_stack is not None:
                 trace_stack.close()
+            self._inflight_tasks.pop(task_id.hex(), None)
+            rec = _flight()
+            if rec.enabled:
+                rec.record(
+                    "task",
+                    spec.get("name") or spec["kind"],
+                    (time.time() - start_time) * 1e3,
+                    {"task_kind": spec["kind"], "error": True}
+                    if task_failed
+                    else {"task_kind": spec["kind"]},
+                )
             self._ctx.task_id = None
             self._ctx.pg_context = None
         if reply_to is not None:

@@ -63,6 +63,14 @@ def init(
 def shutdown() -> None:
     global _session
     if _session is not None:
+        # Stop the metrics flusher BEFORE the session dies: it gets a
+        # final flush against a live worker, and the singleton reset
+        # means a later re-init binds a fresh buffer (the old flusher
+        # thread would otherwise outlive this session and silently
+        # throw records at a dead worker forever).
+        from .util.metrics import _shutdown_buffer
+
+        _shutdown_buffer()
         _session.shutdown()
         _session = None
 
@@ -178,6 +186,35 @@ def timeline() -> List[dict]:
 
 def state_summary() -> dict:
     return _worker().call("state_summary")["summary"]
+
+
+def diagnose(
+    *,
+    hung_task_s: Optional[float] = None,
+    straggler_threshold: Optional[float] = None,
+    capture_stacks: bool = True,
+) -> dict:
+    """Stall doctor: one verdict over head task state, per-worker
+    in-flight views, step telemetry, and flight-recorder digests —
+    stragglers (worker median step time > cluster p50 × threshold),
+    hung tasks (in flight past the deadline, stack auto-captured via
+    the profile relay), unresponsive workers, dead nodes. The CLI
+    surface is `ray_tpu doctor`; thresholds default to the cluster
+    config (`doctor_hung_task_s`, `doctor_straggler_threshold`)."""
+    kwargs: Dict[str, Any] = {"capture_stacks": capture_stacks}
+    if hung_task_s is not None:
+        kwargs["hung_task_s"] = float(hung_task_s)
+    if straggler_threshold is not None:
+        kwargs["straggler_threshold"] = float(straggler_threshold)
+    # Step records may still sit in this process's metrics buffer.
+    # Best-effort: a doctor run against a sick cluster must not die
+    # on the flush that the verdict would have explained.
+    from .util.metrics import flush_best_effort
+
+    flush_best_effort()
+    return _worker().call("diagnose", timeout=120.0, **kwargs)[
+        "verdict"
+    ]
 
 
 class RuntimeContext:

@@ -56,7 +56,7 @@ _PAGE = """<!doctype html>
 </main>
 <script>
 const TABS = ["nodes","actors","tasks","objects","placement_groups",
-              "resources","metrics","spans"];
+              "resources","metrics","spans","steps","doctor"];
 let active = "nodes";
 const $ = (id) => document.getElementById(id);
 function tabs() {
@@ -105,7 +105,7 @@ async function tick() {
     const data = await j("/api/" + tab);
     if (tab !== active) return;
     $("view").innerHTML = table(
-      tab === "resources" || tab === "metrics"
+      tab === "resources" || tab === "metrics" || tab === "steps"
         ? Object.entries(data).map(([k,v]) => ({name:k, ...(
             typeof v === "object" ? v : {value:v})}))
         : data);
@@ -158,6 +158,11 @@ class Dashboard:
         from .util import state as state_api
 
         self._state = state_api
+        # Per-INSTANCE doctor cache: a class-level one would survive
+        # shutdown/re-init and serve cluster A's verdict as cluster
+        # B's health for up to a TTL.
+        self._doctor_cache = (0.0, None)
+        self._doctor_lock = threading.Lock()
         dashboard = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -203,6 +208,8 @@ class Dashboard:
             },
             "metrics": self._metrics,
             "spans": self._spans,
+            "steps": self._steps,
+            "doctor": self._doctor,
         }
         fn = handlers.get(kind)
         if fn is None:
@@ -238,6 +245,57 @@ class Dashboard:
             }
             for r in reversed(records)
         ]
+
+    @staticmethod
+    def _steps():
+        """Gang-step telemetry digest: per-worker step-time stats +
+        per-step skew, newest-first raw records behind it."""
+        from ._private.worker import global_worker
+
+        worker = global_worker()
+        if worker is None:
+            return {}
+        reply = worker.call("step_summary", limit=1000)
+        summary = reply["summary"]
+        return {
+            "max_skew_ms": summary.get("max_skew_ms", 0.0),
+            "steps_observed": summary.get("steps_observed", 0),
+            **{
+                f"rank {rank}": row
+                for rank, row in sorted(
+                    summary.get("workers", {}).items()
+                )
+            },
+        }
+
+    #: Seconds a doctor verdict is served to polls before refresh:
+    #: diagnose fans out per-worker inspect RPCs cluster-wide, far
+    #: too heavy for the page's 2-second tick.
+    _DOCTOR_TTL_S = 10.0
+
+    def _doctor(self):
+        """Stall-doctor verdict (rt.diagnose), cached for
+        _DOCTOR_TTL_S. Stacks are skipped: a dashboard poll must not
+        trigger cluster-wide profile captures — use `ray_tpu doctor`
+        for those. The lock keeps one diagnose in flight no matter
+        how many polls stack up behind a slow one (ThreadingHTTPServer
+        + a 2 s page tick would otherwise fan out a cluster-wide
+        diagnose per poll exactly when the cluster is sick); waiters
+        re-check the cache the refresher just filled."""
+        import time
+
+        import ray_tpu
+
+        with self._doctor_lock:
+            now = time.monotonic()
+            cached_at, verdict = self._doctor_cache
+            if (
+                verdict is None
+                or now - cached_at >= self._DOCTOR_TTL_S
+            ):
+                verdict = ray_tpu.diagnose(capture_stacks=False)
+                self._doctor_cache = (time.monotonic(), verdict)
+        return verdict
 
     @staticmethod
     def _profile(query: str):

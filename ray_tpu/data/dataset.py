@@ -21,6 +21,7 @@ import numpy as np
 
 import ray_tpu as rt
 
+from .._private import step_telemetry as _telemetry
 from .block import (
     Block,
     batch_to_rows,
@@ -443,8 +444,11 @@ class Dataset:
             blocks(), batch_size, batch_format, drop_last
         )
         if prefetch_batches > 0:
-            return _prefetched(batches, prefetch_batches)
-        return batches
+            batches = _prefetched(batches, prefetch_batches)
+        # Outermost boundary: what's timed is the consumer-visible
+        # stall per batch (post-prefetch), accumulated as the
+        # data_wait_ms step phase (_private/step_telemetry.py).
+        return _telemetry.timed_iter(batches, "data_wait_ms")
 
     def take(self, n: int = 20) -> List[dict]:
         out: List[dict] = []
@@ -740,8 +744,8 @@ class DataIterator:
             self.iter_blocks(), batch_size, batch_format, drop_last
         )
         if prefetch_batches > 0:
-            return _prefetched(batches, prefetch_batches)
-        return batches
+            batches = _prefetched(batches, prefetch_batches)
+        return _telemetry.timed_iter(batches, "data_wait_ms")
 
     def __reduce__(self):
         return (DataIterator, (self._coordinator, self._index))

@@ -466,6 +466,64 @@ def cmd_serve_shutdown(args) -> None:
     print("serve shut down")
 
 
+def cmd_doctor(args) -> None:
+    """`ray_tpu doctor` — the stall doctor. One verdict over head
+    task state, per-worker in-flight views, step telemetry, and
+    flight-recorder digests: stragglers, hung tasks (stacks
+    auto-captured), unresponsive workers, dead nodes. Exit-code
+    contract matches lint/check: 0 healthy, 1 when problems are
+    found (connection/usage failures exit via argparse/sys.exit)."""
+    rt = _connect(args)
+    verdict = rt.diagnose(
+        hung_task_s=args.hung_task_s,
+        straggler_threshold=args.straggler_threshold,
+        capture_stacks=not args.no_stacks,
+    )
+    if args.trace:
+        # One chrome trace out of all three streams: task slices
+        # (queue time split out), spans, per-rank step phases.
+        from .._private.worker import global_worker
+
+        from ..util.tracing import merge_chrome_trace
+
+        worker = global_worker()
+        merge_chrome_trace(
+            worker.call("list_task_events", limit=10000)["events"],
+            worker.call("list_spans", limit=10000)["spans"],
+            worker.call("step_summary", limit=10000, records=True)[
+                "records"
+            ],
+            args.trace,
+        )
+    problems = verdict.get("problems", [])
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, default=str))
+        sys.exit(1 if problems else 0)
+    nodes = verdict.get("nodes", {})
+    steps = verdict.get("steps", {})
+    print(
+        f"nodes: {nodes.get('alive', '?')}/{nodes.get('total', '?')} "
+        "alive"
+    )
+    print(
+        f"steps observed: {steps.get('steps_observed', 0)} "
+        f"(workers reporting: {len(steps.get('workers', {}))}, "
+        f"max gang skew: {steps.get('max_skew_ms', 0.0):g} ms)"
+    )
+    if verdict.get("healthy"):
+        print("verdict: HEALTHY")
+        return
+    print(f"verdict: {len(problems)} problem(s)")
+    for problem in problems:
+        print(f"  [{problem.get('kind')}] {problem.get('detail')}")
+        stack = problem.get("stack")
+        if stack:
+            print("    captured stack:")
+            for line in str(stack).splitlines():
+                print(f"      {line}")
+    sys.exit(1)
+
+
 def cmd_lint(args) -> None:
     """`ray_tpu lint [paths]` — the framework-aware distributed-
     correctness linter (devtools/lint.py, rules RT001-RT008). Runs
@@ -658,6 +716,37 @@ def main(argv=None) -> None:
     )
     p_sdown.add_argument("--address")
     p_sdown.set_defaults(fn=cmd_serve_shutdown)
+
+    p_doc = sub.add_parser(
+        "doctor",
+        help="stall doctor: stragglers, hung tasks (with stacks), "
+        "dead nodes, gang-step skew",
+    )
+    p_doc.add_argument("--address")
+    p_doc.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the verdict as JSON (CI mode; exit 1 on problems)",
+    )
+    p_doc.add_argument(
+        "--hung-task-s", type=float, default=None,
+        help="a task with no progress past this deadline counts as "
+        "hung (default: cluster config doctor_hung_task_s)",
+    )
+    p_doc.add_argument(
+        "--straggler-threshold", type=float, default=None,
+        help="a worker whose median step time exceeds cluster p50 x "
+        "this factor is a straggler (default: cluster config)",
+    )
+    p_doc.add_argument(
+        "--no-stacks", action="store_true",
+        help="skip auto-capturing stack dumps of hung tasks' workers",
+    )
+    p_doc.add_argument(
+        "--trace", metavar="OUT.json",
+        help="also write a merged chrome trace (task slices + spans "
+        "+ per-rank step phases) to this path",
+    )
+    p_doc.set_defaults(fn=cmd_doctor)
 
     p_lint = sub.add_parser(
         "lint",

@@ -1,6 +1,7 @@
 """Training library: JaxTrainer (DataParallelTrainer-shaped), sharded
 train steps, sessions, backends, and checkpointing."""
 
+from . import telemetry
 from .backend import Backend, CpuTestBackend, JaxBackend
 from .checkpoint import (
     CheckpointManager,
@@ -35,6 +36,7 @@ from .trainer import JaxTrainer
 from .worker_group import WorkerGroup
 
 __all__ = [
+    "telemetry",
     "JaxTrainer",
     "ScalingConfig",
     "RunConfig",

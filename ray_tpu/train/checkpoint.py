@@ -24,8 +24,11 @@ import json
 import os
 import shutil
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
+
+from .._private import step_telemetry
 
 # -- async writer machinery --------------------------------------------------
 
@@ -110,8 +113,15 @@ def save_checkpoint(
     gathers per-host — rather than racing the next step's donation.
     """
     path = os.path.abspath(path)
+    # The step-blocking portion of a save (full write when sync, the
+    # device->host snapshot when async) is a train-loop phase the step
+    # telemetry attributes per step.
+    t0 = time.monotonic()
     if not async_save or not _fully_addressable(state):
         _write_payload(path, state, metadata)
+        step_telemetry.add_phase(
+            "ckpt_block_ms", (time.monotonic() - t0) * 1e3
+        )
         return path
     snapshot = _host_snapshot(state)
     executor = _writer()
@@ -122,6 +132,9 @@ def save_checkpoint(
         # same-path saves in submission order).
         future = executor.submit(_write_payload, path, snapshot, metadata)
         _PENDING.setdefault(path, []).append(future)
+    step_telemetry.add_phase(
+        "ckpt_block_ms", (time.monotonic() - t0) * 1e3
+    )
     return path
 
 

@@ -9,8 +9,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from .._private import step_telemetry
 
 _CKPT_MARKER = ".latest_checkpoint"
 
@@ -39,15 +42,52 @@ class _Session:
         self._result_callback = result_callback
         self.dataset_shards = dataset_shards or {}
         self._lock = threading.Lock()
+        # Step telemetry: report() is the per-step heartbeat of every
+        # train loop, so it doubles as the step boundary — the wall
+        # interval between consecutive reports, minus the wait phases
+        # the data/H2D/checkpoint layers accumulated in that window,
+        # is the step's own time.
+        self._step_index = 0
+        self._last_report_t = time.monotonic()
+        # Drop phases accumulated on this thread BEFORE the session
+        # existed (warmup/validation passes over instrumented
+        # iterators): step 1 must not inherit their stall time.
+        step_telemetry.take_phases()
 
     def report(
         self, metrics: Dict[str, Any], checkpoint: Optional[str] = None
     ) -> None:
+        now = time.monotonic()
         with self._lock:
             self.results.append(dict(metrics))
             if checkpoint is not None:
                 self.latest_checkpoint = checkpoint
                 self._persist_marker(checkpoint)
+            self._step_index += 1
+            step = self._step_index
+            wall_ms = (now - self._last_report_t) * 1e3
+            self._last_report_t = now
+        # An explicit step_ms metric (the loop timed its own step) wins
+        # over the wall-minus-waits derivation.
+        step_ms = metrics.get("step_ms")
+        try:
+            step_ms = None if step_ms is None else float(step_ms)
+        except (TypeError, ValueError):
+            step_ms = None
+        # The first report's wall interval starts at session
+        # construction, so everything train_func did before its loop
+        # (model build, dataset setup) is inside it — a derived
+        # step_ms would be setup time, not a step. Flag it so the
+        # head's stats/skew (and the chrome trace) exclude it instead
+        # of reporting setup noise as the cluster's max skew.
+        warmup = step == 1 and step_ms is None
+        step_telemetry.report_step(
+            step,
+            rank=self.context.world_rank,
+            step_ms=step_ms,
+            wall_ms=wall_ms,
+            extra={"warmup": 1} if warmup else None,
+        )
         if self._result_callback is not None:
             self._result_callback(metrics, checkpoint)
 

@@ -202,23 +202,44 @@ def prefetch_to_device(
     shard_batch would use. buffer_size=2 is classic double buffering;
     1 degenerates to put-then-yield with no overlap.
     """
+    import time as _time
     from collections import deque
+
+    from .._private import step_telemetry
 
     if buffer_size < 1:
         raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
     sharding = NamedSharding(mesh, spec_for(logical_axes, rules))
 
     def put(batch):
-        return jax.tree.map(
+        # H2D dispatch time, attributed per step (device_put is an
+        # async dispatch on TPU/GPU — what's measured is the stall the
+        # loop pays, which is exactly the number the doctor wants).
+        t0 = _time.monotonic()
+        out = jax.tree.map(
             lambda x: jax.device_put(x, sharding), batch
         )
+        step_telemetry.add_phase(
+            "h2d_ms", (_time.monotonic() - t0) * 1e3
+        )
+        return out
 
     window: "deque" = deque()
     iterator = iter(batches)
+
+    def pull():
+        # data_wait is timed at this outermost consumer boundary;
+        # phase_timer's reentrancy guard keeps a telemetry-wrapped
+        # source — even one buried under user transforms, e.g.
+        # (augment(b) for b in ds.iter_batches(...)) — from billing
+        # the same stall twice.
+        with step_telemetry.phase_timer("data_wait_ms"):
+            return next(iterator)
+
     while True:
         while len(window) < buffer_size:
             try:
-                window.append(put(next(iterator)))
+                window.append(put(pull()))
             except StopIteration:
                 while window:
                     yield window.popleft()

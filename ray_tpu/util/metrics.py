@@ -9,13 +9,20 @@ node daemon's KV-style metric table on the head and are queried with
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
-import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions as exc
 
+logger = logging.getLogger(__name__)
+
 _FLUSH_INTERVAL_S = 0.5
+#: Records kept while the head is unreachable (failed flushes requeue
+#: their batch rather than dropping it; oldest age out past this cap).
+_MAX_BUFFERED = 10000
 
 
 def _worker():
@@ -28,7 +35,20 @@ def _worker():
 
 
 class _Buffer:
-    """Per-process record buffer with a background flusher."""
+    """Per-process record buffer with a background flusher.
+
+    Lifecycle: `reset()` (called by ray_tpu.shutdown()) stops the
+    flusher thread and drops the singleton, so a re-init gets a fresh
+    buffer + thread bound to the NEW worker — the old flusher no
+    longer survives shutdown silently dropping records against a dead
+    session. A flush SEALS the pending records into a numbered batch
+    and delivers sealed batches in order, each tagged (sender, seq);
+    the head drops seqs it already applied, so a retry after a lost
+    reply cannot double-count — outages cost retries, not records and
+    not duplicates. Failed batches stay sealed (bounded) for the next
+    tick; the background loop warns ONCE per outage instead of
+    swallowing every exception forever, while an explicit `flush()`
+    raises."""
 
     _instance: Optional["_Buffer"] = None
     _lock = threading.Lock()
@@ -36,6 +56,11 @@ class _Buffer:
     def __init__(self):
         self.records: List[tuple] = []
         self.records_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._warned = False
+        self._sender = uuid.uuid4().hex
+        self._seq = 0
+        self._sealed: List[Tuple[int, List[tuple]]] = []
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -46,24 +71,107 @@ class _Buffer:
                 cls._instance = cls()
             return cls._instance
 
+    @classmethod
+    def reset(cls) -> None:
+        """Final best-effort flush, stop the flusher, drop the
+        singleton (ray_tpu.shutdown() path)."""
+        with cls._lock:
+            buf, cls._instance = cls._instance, None
+        if buf is None:
+            return
+        buf._stop.set()
+        buf.flush(raise_on_error=False)
+        buf.thread.join(timeout=2.0)
+
+    @classmethod
+    def _reset_after_fork(cls) -> None:
+        # The flusher thread does not survive fork; drop any
+        # inherited singleton so the child lazily creates a live one
+        # (no lock: the parent may have held it mid-fork).
+        cls._instance = None
+
     def push(self, record: tuple) -> None:
         with self.records_lock:
             self.records.append(record)
 
     def _loop(self) -> None:
-        while True:
-            time.sleep(_FLUSH_INTERVAL_S)
-            self.flush()
+        while not self._stop.wait(_FLUSH_INTERVAL_S):
+            self.flush(raise_on_error=False)
 
-    def flush(self) -> None:
+    def _seal_and_trim_locked(self) -> None:
+        """Move pending records into a new sealed batch and enforce
+        the buffered-record cap across sealed batches. Caller holds
+        `records_lock`. Boundary-carrying records (the 5-tuple each
+        Histogram sends ONCE per buffer generation) survive trimming
+        unconditionally: age them out and the head could never bucket
+        that histogram again this process lifetime."""
+        if self.records:
+            self._seq += 1
+            self._sealed.append((self._seq, self.records))
+            self.records = []
+        overflow = (
+            sum(len(b) for _, b in self._sealed) - _MAX_BUFFERED
+        )
+        if overflow > 0:
+            trimmed = []
+            for seq, batch in self._sealed:
+                if overflow > 0:
+                    cut = min(overflow, len(batch))
+                    declares = [
+                        r for r in batch[:cut] if len(r) > 4
+                    ]
+                    batch = declares + batch[cut:]
+                    overflow -= cut
+                if batch:
+                    trimmed.append((seq, batch))
+            self._sealed = trimmed
+
+    def flush(self, raise_on_error: bool = True) -> None:
         with self.records_lock:
-            batch, self.records = self.records, []
-        if not batch:
-            return
-        try:
-            _worker().call("metrics_record", records=batch)
-        except Exception:
-            pass
+            self._seal_and_trim_locked()
+            pending = list(self._sealed)
+        for seq, batch in pending:
+            try:
+                # Bounded: an accepted-but-never-answered head (the
+                # wedged-cluster case the doctor exists to diagnose)
+                # must fail this flush — not hang rt.diagnose()'s
+                # pre-read flush or shutdown()'s final one forever. A
+                # timed-out batch stays sealed; head-side seq dedup
+                # absorbs the retry if it was actually applied.
+                _worker().call(
+                    "metrics_record",
+                    records=batch,
+                    sender=self._sender,
+                    seq=seq,
+                    timeout=30.0,
+                )
+            except Exception as e:
+                # The batch stays sealed under its seq for the next
+                # tick: retried delivery is deduplicated head-side,
+                # so an outage costs retries, not records and not
+                # double-counts.
+                if raise_on_error:
+                    raise exc.RayTpuError(
+                        f"metrics flush failed: {e}"
+                    ) from e
+                if not self._warned:
+                    self._warned = True
+                    logger.warning(
+                        "metrics flush failed (%s); records are "
+                        "buffered (max %d) and the flusher will keep "
+                        "retrying — this is logged once per outage",
+                        e,
+                        _MAX_BUFFERED,
+                    )
+                return
+            with self.records_lock:
+                self._sealed = [
+                    (s, b) for s, b in self._sealed if s != seq
+                ]
+        self._warned = False
+
+
+os.register_at_fork(after_in_child=_Buffer._reset_after_fork)
 
 
 class _Metric:
@@ -120,21 +228,49 @@ class Histogram(_Metric):
         tag_keys: Sequence[str] = (),
     ):
         super().__init__(name, description, tag_keys)
-        self._boundaries = list(boundaries)
+        # Sorted up front: the head buckets with bisect against them.
+        self._boundaries = sorted(float(b) for b in boundaries)
+        self._declared_for: Optional["_Buffer"] = None
 
     def observe(self, value: float, tags: Optional[dict] = None):
-        _Buffer.get().push(
-            (self.KIND, self._name, float(value), self._tags(tags))
-        )
+        # Boundaries ride the instance's FIRST record per buffer
+        # generation (5th field; counters and gauges stay 4-tuples):
+        # the head keeps first-seen boundaries per name, so repeating
+        # them on every observation is pure wire/CPU overhead. Keyed
+        # to the buffer object — shutdown/re-init and fork build a
+        # fresh buffer, whose (possibly new) head needs a re-declare.
+        buf = _Buffer.get()
+        rec = (self.KIND, self._name, float(value), self._tags(tags))
+        if self._declared_for is not buf:
+            rec = rec + (tuple(self._boundaries),)
+            self._declared_for = buf
+        buf.push(rec)
 
 
 def flush() -> None:
-    """Force-flush this process's buffered records (tests/shutdown)."""
+    """Force-flush this process's buffered records (tests/shutdown).
+    Raises RayTpuError when the records cannot be delivered (the
+    background flusher instead warns once and retries)."""
     _Buffer.get().flush()
+
+
+def flush_best_effort() -> None:
+    """Flush without raising: a transient delivery failure requeues
+    the batch for the background flusher instead of failing the
+    caller (pre-read flushes in summaries and the doctor)."""
+    _Buffer.get().flush(raise_on_error=False)
+
+
+def _shutdown_buffer() -> None:
+    """ray_tpu.shutdown() hook: stop the flusher and drop the
+    singleton so re-init binds a fresh buffer to the new session."""
+    _Buffer.reset()
 
 
 def metrics_summary() -> Dict[str, dict]:
     """Cluster-wide aggregated metrics: {name: {kind, total/value/
-    count, by_tags}}."""
-    flush()
+    count, by_tags}}. The incidental pre-read flush is best-effort —
+    a transient delivery failure requeues the batch for the
+    background flusher instead of failing the read."""
+    flush_best_effort()
     return _worker().call("metrics_summary")["metrics"]

@@ -18,7 +18,16 @@ _BEGIN_STATES = {
     "PENDING_ARGS_AVAIL",
     "FORWARDED",
     "PENDING_NODE_ASSIGNMENT",
+    # Re-queue transitions: a retried/reconstructing task is waiting
+    # to be scheduled again — that wait is queue time, not runtime.
+    "RETRY",
+    "RECONSTRUCTING",
 }
+#: Transitions that put an already-dispatched task BACK in the queue:
+#: the lifecycle splits into attempts here, each with its own slice
+#: and queue accounting (one slice across a retry would bill the
+#: reschedule wait as runtime).
+_REQUEUE_STATES = {"RETRY", "RECONSTRUCTING"}
 _END_STATES = {"FINISHED", "FAILED", "DONE"}
 
 
@@ -33,25 +42,74 @@ def timeline_to_chrome_trace(
     trace = []
     for task_id, task_events in by_task.items():
         task_events.sort(key=lambda e: e["time"])
-        start = task_events[0]
-        end = task_events[-1]
-        duration_us = max(1.0, (end["time"] - start["time"]) * 1e6)
-        trace.append(
-            {
-                "name": start.get("name") or start.get("kind", "task"),
-                "cat": start.get("kind", "task"),
-                "ph": "X",
-                "ts": start["time"] * 1e6,
-                "dur": duration_us,
-                "pid": "cluster",
-                "tid": task_id[:8],
-                "args": {
-                    "task_id": task_id,
-                    "final_state": end["state"],
-                    "states": [e["state"] for e in task_events],
-                },
+        # Split the lifecycle into attempts at re-queue transitions,
+        # then anchor each attempt's slice at its first
+        # RUNNING-adjacent event: a single slice from submission to
+        # completion would bill queue time (PENDING_*/FORWARDED, and
+        # any RETRY reschedule wait) as runtime. Queue time is still
+        # reported — as each slice's own arg, not inside it.
+        attempts: List[List[dict]] = [[]]
+        for e in task_events:
+            if e["state"] in _REQUEUE_STATES and attempts[-1]:
+                # The requeue event both CLOSES the running attempt
+                # (its end timestamp) and OPENS the next one's queue
+                # period.
+                attempts[-1].append(e)
+                attempts.append([])
+            attempts[-1].append(e)
+        for idx, attempt in enumerate(attempts):
+            submitted = attempt[0]
+            start = next(
+                (
+                    e
+                    for e in attempt
+                    if e["state"] not in _BEGIN_STATES
+                ),
+                None,
+            )
+            end = next(
+                (e for e in attempt if e["state"] in _END_STATES),
+                attempt[-1],
+            )
+            if start is None:
+                # This attempt never left the queue: its whole span
+                # is queue time, not runtime — render a minimal
+                # marker slice at its start so nothing reads as
+                # execution.
+                start = submitted
+                queued_us = max(
+                    0.0, (end["time"] - submitted["time"]) * 1e6
+                )
+                duration_us = 1.0
+            else:
+                queued_us = max(
+                    0.0, (start["time"] - submitted["time"]) * 1e6
+                )
+                duration_us = max(
+                    1.0, (end["time"] - start["time"]) * 1e6
+                )
+            args = {
+                "task_id": task_id,
+                "final_state": end["state"],
+                "queued_us": round(queued_us, 1),
+                "states": [e["state"] for e in attempt],
             }
-        )
+            if len(attempts) > 1:
+                args["attempt"] = idx + 1
+                args["attempts"] = len(attempts)
+            trace.append(
+                {
+                    "name": task_events[0].get("name")
+                    or task_events[0].get("kind", "task"),
+                    "cat": task_events[0].get("kind", "task"),
+                    "ph": "X",
+                    "ts": start["time"] * 1e6,
+                    "dur": duration_us,
+                    "pid": "cluster",
+                    "tid": task_id[:8],
+                    "args": args,
+                }
+            )
     if path is not None:
         with open(path, "w") as f:
             json.dump(trace, f)
@@ -209,6 +267,51 @@ def spans_to_otlp(records) -> dict:
             }],
         }]
     }
+
+
+def spans_to_chrome_trace(records) -> List[dict]:
+    """Span records -> chrome trace 'X' slices (one pid per trace,
+    one tid per span chain depth proxy: the span id). Lets spans sit
+    in the same chrome://tracing view as task slices and step
+    phases (`ray_tpu doctor --trace`)."""
+    trace = []
+    for r in records:
+        trace.append(
+            {
+                "name": r["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": r["start_ns"] / 1e3,
+                "dur": max(
+                    1.0, (r["end_ns"] - r["start_ns"]) / 1e3
+                ),
+                "pid": f"trace:{r['trace_id'][:8]}",
+                "tid": r.get("parent_span_id") or "root",
+                "args": dict(r.get("attributes") or {}),
+            }
+        )
+    return trace
+
+
+def merge_chrome_trace(
+    task_events: List[dict],
+    span_records: List[dict],
+    step_records: List[dict],
+    path: Optional[str] = None,
+) -> List[dict]:
+    """One chrome trace out of the three observability streams: task
+    state-event slices (queue time excluded per the slice anchor
+    above), finished spans, and per-step per-rank phase slices. The
+    `ray_tpu doctor --trace out.json` artifact."""
+    from .._private.step_telemetry import steps_to_chrome_trace
+
+    trace = timeline_to_chrome_trace(task_events)
+    trace.extend(spans_to_chrome_trace(span_records))
+    trace.extend(steps_to_chrome_trace(step_records))
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
 
 
 def export_otlp(path: "str | None" = None) -> dict:

@@ -94,6 +94,62 @@ def test_task_workload_converges_under_pull_and_meta_chaos(chaos_cluster):
     assert total == 2.0 * 600_000.0
 
 
+def test_metrics_and_flight_recorder_survive_rpc_chaos(chaos_cluster):
+    """Observability under faults (satellite): chaos-drop the first
+    metrics_record flushes — the flusher must requeue the batch and
+    deliver it on a later tick (never wedging, never dropping), and
+    the flight recorder must keep recording throughout."""
+    import time
+
+    from ray_tpu._private.flight_recorder import recorder
+    from ray_tpu._private.rpc import configure_chaos
+    from ray_tpu.util.metrics import Counter, metrics_summary
+
+    rt, _ = chaos_cluster
+    counter = Counter("chaos_survivor")
+    counter.inc(1.0)
+    counter.inc(2.0)
+    configure_chaos("metrics_record=2")
+    # The background flusher eats the injected failures (requeue +
+    # warn-once) and converges once the budget is spent.
+    deadline = time.time() + 30
+    total = None
+    while time.time() < deadline:
+        try:
+            total = (
+                metrics_summary()
+                .get("chaos_survivor", {})
+                .get("total")
+            )
+        except Exception:
+            # metrics_summary force-flushes; while the chaos budget
+            # lasts, the explicit flush path is allowed to raise.
+            total = None
+        if total == 3.0:
+            break
+        time.sleep(0.3)
+    assert total == 3.0
+    # The flusher thread survived the outage and keeps delivering.
+    from ray_tpu.util.metrics import _Buffer
+
+    assert _Buffer.get().thread.is_alive()
+    counter.inc(4.0)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if (
+            metrics_summary()["chaos_survivor"]["total"] == 7.0
+        ):
+            break
+        time.sleep(0.3)
+    assert metrics_summary()["chaos_survivor"]["total"] == 7.0
+    # The driver's flight-recorder ring recorded client RPCs through
+    # the whole episode (the successful retry among them).
+    assert any(
+        r["kind"] == "rpc.client" and r["name"] == "metrics_record"
+        for r in recorder().snapshot()
+    )
+
+
 def test_chaos_budget_is_finite_and_clears():
     """The spec drops exactly the first N calls: once the budget is
     consumed, the method flows normally again (budget bookkeeping in

@@ -1,0 +1,441 @@
+"""Stall-doctor tests (reference test model: observability e2e tests
+over ray's state API + dashboard profiling relay).
+
+Covers the three diagnosis sources end to end: step telemetry
+(straggler detection + gang skew), per-worker in-flight inspection
+(hung tasks, with the offender's stack auto-captured through the
+profile relay), and the flight-recorder rings — plus the
+`ray_tpu doctor --json` CLI exit-code contract (0 healthy, 1 problems
+found; same shape as lint/check) on a 2-node cluster with one
+artificially delayed worker.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _emit_steps(rank: int, step_ms: float, steps: int = 5) -> None:
+    from ray_tpu.train import telemetry
+
+    for step in range(1, steps + 1):
+        telemetry.report_step(
+            step, rank=rank, step_ms=step_ms, wall_ms=step_ms + 10.0
+        )
+
+
+def test_diagnose_healthy_cluster(rt_session):
+    rt = rt_session
+    verdict = rt.diagnose(capture_stacks=False)
+    assert verdict["healthy"] is True
+    assert verdict["problems"] == []
+    assert verdict["nodes"]["alive"] >= 1
+    assert "params" in verdict
+
+
+def test_diagnose_flags_straggler_rank(rt_session):
+    """Per-step records from two ranks, one 4x slower: the verdict
+    names the slow rank, its ratio, and the gang skew it causes."""
+    rt = rt_session
+    _emit_steps(rank=0, step_ms=100.0)
+    _emit_steps(rank=1, step_ms=400.0)
+    verdict = rt.diagnose(
+        straggler_threshold=1.5, capture_stacks=False
+    )
+    stragglers = [
+        p for p in verdict["problems"] if p["kind"] == "straggler"
+    ]
+    assert len(stragglers) == 1
+    assert stragglers[0]["rank"] == 1
+    assert stragglers[0]["ratio"] == pytest.approx(4.0)
+    assert verdict["steps"]["max_skew_ms"] == pytest.approx(300.0)
+    # Both ranks' per-worker stats are in the verdict for context.
+    assert set(verdict["steps"]["workers"]) == {0, 1}
+
+
+def test_step_summary_round_trip(rt_session):
+    from ray_tpu.train import telemetry
+
+    _emit_steps(rank=0, step_ms=50.0, steps=3)
+    summary = telemetry.step_summary()
+    assert summary["steps_observed"] == 3
+    assert summary["workers"][0]["p50_step_ms"] == pytest.approx(50.0)
+    records = telemetry.step_records()
+    assert len(records) == 3
+    assert {r["step"] for r in records} == {1, 2, 3}
+    # wall - step = the 10 ms of waits _emit_steps bakes in.
+    assert records[0]["wall_ms"] == pytest.approx(60.0)
+
+
+def test_step_summary_isolates_jobs():
+    """Straggler/skew stats must never be computed over a mixture of
+    jobs: an older job's slow steps in the ring would otherwise fake
+    a straggler in (or hide one from) the current run."""
+    from ray_tpu._private.daemon import _summarize_steps
+
+    old = [
+        {"step": s, "rank": 0, "step_ms": 500.0,
+         "time": 100.0 + s, "job": "a"}
+        for s in range(1, 6)
+    ]
+    new = [
+        {"step": s, "rank": 0, "step_ms": 100.0,
+         "time": 200.0 + s, "job": "b"}
+        for s in range(1, 6)
+    ]
+    summary = _summarize_steps(old + new)
+    assert summary["jobs_observed"] == 2
+    # Only the newest job's records feed the stats.
+    assert summary["workers"][0]["steps"] == 5
+    assert summary["workers"][0]["p50_step_ms"] == pytest.approx(
+        100.0
+    )
+
+
+def test_session_report_emits_step_telemetry(rt_session):
+    """The tentpole's always-on path: a train session's report() is
+    the step boundary — each one emits a (step, rank) record through
+    the metrics pipe carrying the wait phases the data layer
+    accumulated (here: a real Dataset.iter_batches drive), and the
+    head's summary shows both ranks."""
+    rt = rt_session
+
+    @rt.remote
+    def run_gang_member(rank):
+        import time as _time
+
+        import ray_tpu.data as rtd
+        from ray_tpu.train.session import (
+            TrainContext,
+            clear_session,
+            init_session,
+            report,
+        )
+        from ray_tpu.util import metrics
+
+        dataset = rtd.range(12)
+        init_session(TrainContext(world_rank=rank, world_size=2))
+        try:
+            for _ in dataset.iter_batches(batch_size=4):
+                _time.sleep(0.01 * (1 + rank))  # the "step"
+                report({"loss": 1.0})
+        finally:
+            clear_session()
+        metrics.flush()
+        return rank
+
+    assert rt.get(
+        [run_gang_member.remote(r) for r in range(2)], timeout=120
+    ) == [0, 1]
+    from ray_tpu.train import telemetry
+
+    deadline = time.time() + 15
+    summary = {}
+    while time.time() < deadline:
+        summary = telemetry.step_summary()
+        if set(summary.get("workers", {})) == {0, 1}:
+            break
+        time.sleep(0.3)
+    assert set(summary["workers"]) == {0, 1}
+    assert summary["steps_observed"] == 3
+    records = telemetry.step_records()
+    # Every record carries the data plane's consumer-visible stall
+    # and a non-negative step residual.
+    assert all("data_wait_ms" in r for r in records)
+    assert all(r["step_ms"] >= 0.0 for r in records)
+    assert all(r["wall_ms"] > 0.0 for r in records)
+
+
+def test_diagnose_hung_task_captures_stack(rt_session):
+    """A task sleeping past the deadline is reported hung, and the
+    verdict carries the worker's auto-captured stack showing the
+    offending frame (acceptance criterion b)."""
+    rt = rt_session
+
+    @rt.remote
+    def hang_forever():
+        time.sleep(300)
+
+    ref = hang_forever.remote()
+    try:
+        deadline = time.time() + 60
+        hung = []
+        while time.time() < deadline and not hung:
+            verdict = rt.diagnose(hung_task_s=0.5)
+            hung = [
+                p
+                for p in verdict["problems"]
+                if p["kind"] == "hung_task"
+            ]
+            if not hung:
+                time.sleep(0.3)
+        assert hung, "hung task never detected"
+        assert hung[0]["name"] == "hang_forever"
+        assert hung[0]["age_s"] > 0.5
+        assert "hang_forever" in hung[0].get("stack", ""), (
+            "stack dump should show the hung frame: "
+            f"{hung[0].get('stack', hung[0].get('stack_error'))!r}"
+        )
+    finally:
+        rt.cancel(ref, force=True)
+
+
+def test_diagnose_exempts_progressing_train_task(rt_session):
+    """A long-lived in-flight task whose worker reports step
+    telemetry within the deadline is a train loop making progress,
+    not a hang — gang fit tasks run ONE task for the whole job, and
+    a doctor that flagged every healthy training run would bury the
+    real signal (and break the exit-0-when-healthy contract)."""
+    rt = rt_session
+
+    @rt.remote
+    def fit(total_s):
+        import time as _time
+
+        from ray_tpu.train import telemetry
+        from ray_tpu.util import metrics
+
+        t_end = _time.time() + total_s
+        step = 0
+        while _time.time() < t_end:
+            step += 1
+            telemetry.report_step(
+                step, rank=0, step_ms=50.0, wall_ms=60.0
+            )
+            metrics.flush()
+            _time.sleep(0.2)
+        return step
+
+    ref = fit.remote(12.0)
+    # Wait until the fit task's telemetry is actually flowing (worker
+    # spawn + first-iteration jax import can eat seconds), THEN let it
+    # run past the 0.5s deadline: what's under test is the exemption
+    # of a PROGRESSING task, not spawn latency.
+    from ray_tpu.train import telemetry
+
+    deadline = time.time() + 30.0
+    while not telemetry.step_records(limit=1):
+        assert time.time() < deadline, "fit never reported a step"
+        time.sleep(0.1)
+    time.sleep(1.0)  # now in flight well past the 0.5s hung deadline
+    try:
+        verdict = rt.diagnose(hung_task_s=0.5, capture_stacks=False)
+        hung = [
+            p
+            for p in verdict["problems"]
+            if p["kind"] == "hung_task"
+        ]
+        assert hung == [], hung
+    finally:
+        assert rt.get(ref, timeout=60) > 0
+
+
+def test_flight_recorder_rings_pull_lazily(rt_session):
+    """Rings exist per process and are pulled over RPC on demand:
+    the head's ring shows server-side handling, the driver's shows
+    client latencies, and a worker's (routed by pid) shows task
+    begin/end records."""
+    rt = rt_session
+
+    @rt.remote
+    def work(x):
+        return x * 2
+
+    assert rt.get([work.remote(i) for i in range(3)], timeout=60) == [
+        0,
+        2,
+        4,
+    ]
+    from ray_tpu._private.flight_recorder import recorder
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    head = worker.call("flight_recorder")
+    assert any(r["kind"] == "rpc.server" for r in head["records"])
+    assert any(
+        k.startswith("rpc.server:") for k in head["summary"]
+    )
+    # The driver records its own outbound calls locally — no RPC
+    # needed to read your own ring.
+    own = recorder().snapshot(kinds=["rpc.client"])
+    assert own and all(r["kind"] == "rpc.client" for r in own)
+    # Worker rings route by pid and carry task events.
+    rows = worker.call("worker_inspect")["workers"]
+    task_records = []
+    for row in rows:
+        if row.get("error"):
+            continue
+        reply = worker.call("flight_recorder", pid=row["pid"])
+        task_records.extend(
+            r
+            for r in reply["records"]
+            if r["kind"] == "task" and r["name"] == "work"
+        )
+    assert len(task_records) == 3
+    assert all(r["dur_ms"] >= 0.0 for r in task_records)
+
+
+def test_flight_recorder_disabled_is_inert():
+    from ray_tpu._private.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=64, enabled=False)
+    rec.record("rpc.client", "x", 1.0)
+    assert rec.snapshot() == []
+    rec.enabled = True
+    rec.record("rpc.client", "x", 1.0, {"error": True})
+    assert rec.summary()["rpc.client:x"]["errors"] == 1
+
+
+def test_flight_recorder_env_kill_switch_survives_configure():
+    """RT_flight_recorder_enabled=0 is the documented PER-PROCESS
+    kill-switch: applying the cluster config at registration must not
+    re-enable a ring this process's env disabled."""
+    from ray_tpu._private import flight_recorder
+    from ray_tpu._private.config import Config
+
+    rec = flight_recorder.recorder()
+    prev_enabled = rec.enabled
+    prev_env = os.environ.get("RT_flight_recorder_enabled")
+    try:
+        os.environ["RT_flight_recorder_enabled"] = "0"
+        flight_recorder.configure(
+            Config(flight_recorder_enabled=True)
+        )
+        assert rec.enabled is False
+        del os.environ["RT_flight_recorder_enabled"]
+        flight_recorder.configure(
+            Config(flight_recorder_enabled=True)
+        )
+        assert rec.enabled is True
+    finally:
+        if prev_env is None:
+            os.environ.pop("RT_flight_recorder_enabled", None)
+        else:
+            os.environ["RT_flight_recorder_enabled"] = prev_env
+        rec.enabled = prev_enabled
+
+
+def test_flight_recorder_ring_is_bounded():
+    from ray_tpu._private.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=32)
+    for i in range(100):
+        rec.record("task", f"t{i}", 1.0)
+    snap = rec.snapshot()
+    assert len(snap) == 32
+    assert snap[-1]["name"] == "t99"  # newest kept, oldest evicted
+
+
+@pytest.mark.slow
+def test_doctor_cli_smoke_two_nodes_one_delayed_worker(tmp_path):
+    """CI smoke (satellite): a 2-node cluster where one gang worker is
+    artificially delayed per step; `ray_tpu doctor --json` (a separate
+    process, like an operator would run it) must exit 1 and name the
+    straggler rank; on a freshly quiet cluster it must exit 0.
+    `--trace` writes a merged chrome trace containing step phases."""
+    from ray_tpu.cluster_utils import Cluster
+
+    import ray_tpu as rt
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    c.add_node(num_cpus=2, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+
+        @rt.remote
+        def gang_member(rank, delay_s):
+            from ray_tpu.train import telemetry
+            from ray_tpu.util import metrics
+
+            for step in range(1, 6):
+                t0 = time.monotonic()
+                time.sleep(delay_s)  # the "step"
+                telemetry.report_step(
+                    step,
+                    rank=rank,
+                    wall_ms=(time.monotonic() - t0) * 1e3,
+                )
+            metrics.flush()
+            return rank
+
+        fast = gang_member.options(
+            resources={"remote_node": 1.0}
+        ).remote(0, 0.01)
+        slow = gang_member.remote(1, 0.2)  # the delayed worker
+        assert rt.get([fast, slow], timeout=120) == [0, 1]
+
+        trace_out = tmp_path / "doctor_trace.json"
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "doctor",
+                "--json",
+                "--address",
+                c.address,
+                "--straggler-threshold",
+                "3.0",
+                "--no-stacks",
+                "--trace",
+                str(trace_out),
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 1, out.stdout + out.stderr
+        verdict = json.loads(out.stdout)
+        stragglers = [
+            p
+            for p in verdict["problems"]
+            if p["kind"] == "straggler"
+        ]
+        assert [p["rank"] for p in stragglers] == [1], verdict[
+            "problems"
+        ]
+        assert verdict["steps"]["max_skew_ms"] > 0
+        # The merged chrome trace has the per-rank step phases.
+        trace = json.loads(trace_out.read_text())
+        step_rows = {
+            e["tid"] for e in trace if e.get("cat") == "step"
+        }
+        assert {"rank 0", "rank 1"} <= step_rows
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+    # Exit-code contract, healthy side: a quiet fresh cluster -> 0.
+    c2 = Cluster(initialize_head=True, head_resources={"CPU": 2.0})
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "doctor",
+                "--json",
+                "--address",
+                c2.address,
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert json.loads(out.stdout)["healthy"] is True
+    finally:
+        c2.shutdown()

@@ -86,6 +86,196 @@ def test_chrome_trace_export(rt_session, tmp_path):
         assert event["ph"] == "X" and event["dur"] >= 1
 
 
+def test_timeline_slice_excludes_queue_time():
+    """The chrome slice runs from the first RUNNING-adjacent state to
+    the terminal state; queue time (PENDING_*/FORWARDED) is reported
+    as args.queued_us, not billed as runtime (satellite fix: the dead
+    _BEGIN_STATES/_END_STATES are now load-bearing)."""
+    from ray_tpu.util.tracing import timeline_to_chrome_trace
+
+    t0 = 1000.0
+    events = [
+        {
+            "task_id": "t1",
+            "name": "queued_task",
+            "kind": "normal",
+            "state": state,
+            "time": t0 + dt,
+        }
+        for state, dt in (
+            ("PENDING_NODE_ASSIGNMENT", 0.0),
+            ("FORWARDED", 2.0),
+            ("RUNNING", 5.0),
+            ("FINISHED", 6.0),
+        )
+    ]
+    (slice_,) = timeline_to_chrome_trace(events)
+    assert slice_["ts"] == pytest.approx((t0 + 5.0) * 1e6)
+    assert slice_["dur"] == pytest.approx(1e6)
+    assert slice_["args"]["queued_us"] == pytest.approx(5e6)
+    assert slice_["args"]["final_state"] == "FINISHED"
+
+    # A task with only queued states (never ran) still gets a slice —
+    # a 1 us marker at submission with the whole span reported as
+    # queue time, so none of it reads as execution.
+    (queued_only,) = timeline_to_chrome_trace(events[:2])
+    assert queued_only["ts"] == pytest.approx(t0 * 1e6)
+    assert queued_only["dur"] == pytest.approx(1.0)
+    assert queued_only["args"]["queued_us"] == pytest.approx(2e6)
+    assert queued_only["args"]["final_state"] == "FORWARDED"
+
+
+def test_timeline_retry_splits_into_attempts():
+    """A re-queue transition (RETRY/RECONSTRUCTING) splits the task
+    into per-attempt slices: the reschedule wait must be billed as
+    that attempt's queue time, never as runtime."""
+    from ray_tpu.util.tracing import timeline_to_chrome_trace
+
+    t0 = 1000.0
+    events = [
+        {
+            "task_id": "t1",
+            "name": "retried",
+            "kind": "normal",
+            "state": state,
+            "time": t0 + dt,
+        }
+        for state, dt in (
+            ("PENDING_NODE_ASSIGNMENT", 0.0),
+            ("RUNNING", 1.0),
+            ("RETRY", 2.0),
+            ("FORWARDED", 3.0),
+            ("RUNNING", 62.0),
+            ("FINISHED", 63.0),
+        )
+    ]
+    first, second = timeline_to_chrome_trace(events)
+    # Attempt 1: ran 1s (RUNNING@1 -> RETRY@2 closes the attempt).
+    assert first["ts"] == pytest.approx((t0 + 1.0) * 1e6)
+    assert first["dur"] == pytest.approx(1e6)
+    assert first["args"]["attempt"] == 1
+    # Attempt 2: the 60s reschedule wait is queue time, runtime is
+    # the 1s second execution.
+    assert second["ts"] == pytest.approx((t0 + 62.0) * 1e6)
+    assert second["dur"] == pytest.approx(1e6)
+    assert second["args"]["queued_us"] == pytest.approx(60e6)
+    assert second["args"]["final_state"] == "FINISHED"
+    assert second["args"]["attempts"] == 2
+
+
+def test_requeue_truncation_keeps_boundary_declares():
+    """A head outage long enough to overflow the requeue cap must not
+    age out the one record carrying a histogram's boundaries — the
+    head could never bucket that histogram again."""
+    from ray_tpu.util import metrics
+
+    buf = metrics._Buffer()
+    try:
+        declare = ("histogram", "h", 1.0, (), (10.0, 100.0))
+        buf.push(declare)
+        for _ in range(metrics._MAX_BUFFERED + 5):
+            buf.push(("counter", "c", 1.0, ()))
+        # No session: delivery fails, the sealed batch stays trimmed.
+        buf.flush(raise_on_error=False)
+        with buf.records_lock:
+            buffered = [
+                r for _, batch in buf._sealed for r in batch
+            ]
+        assert declare in buffered
+        assert len(buffered) <= metrics._MAX_BUFFERED + 1
+    finally:
+        buf._stop.set()
+
+
+def test_metrics_redelivery_does_not_double_count(rt_session):
+    """Sealed batches retry until acknowledged; a batch whose reply
+    was lost arrives twice and must be folded in exactly once. Uses a
+    synthetic sender so the live driver's dedup state is untouched."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util.metrics import metrics_summary
+
+    worker = global_worker()
+    batch = [("counter", "dedup_total", 5.0, ())]
+    worker.call(
+        "metrics_record", records=batch, sender="t-sender", seq=7
+    )
+    assert metrics_summary()["dedup_total"]["total"] == 5.0
+    # The lost-reply retry: same (sender, seq) redelivered — dropped.
+    worker.call(
+        "metrics_record", records=batch, sender="t-sender", seq=7
+    )
+    assert metrics_summary()["dedup_total"]["total"] == 5.0
+    # A NEW seq from the same sender still lands.
+    worker.call(
+        "metrics_record",
+        records=[("counter", "dedup_total", 2.0, ())],
+        sender="t-sender",
+        seq=8,
+    )
+    assert metrics_summary()["dedup_total"]["total"] == 7.0
+
+
+def test_merged_chrome_trace_has_all_three_streams(tmp_path):
+    """doctor --trace artifact: task slices + spans + per-rank step
+    phases in one chrome trace, phases laid sequentially inside the
+    step's wall window."""
+    from ray_tpu.util.tracing import merge_chrome_trace
+
+    t0 = 2000.0
+    task_events = [
+        {
+            "task_id": "t1",
+            "name": "task_a",
+            "kind": "normal",
+            "state": "RUNNING",
+            "time": t0,
+        },
+        {
+            "task_id": "t1",
+            "name": "task_a",
+            "kind": "normal",
+            "state": "FINISHED",
+            "time": t0 + 1.0,
+        },
+    ]
+    spans = [
+        {
+            "name": "span_a",
+            "trace_id": "ab" * 16,
+            "span_id": "cd" * 8,
+            "parent_span_id": "",
+            "start_ns": int(t0 * 1e9),
+            "end_ns": int((t0 + 0.5) * 1e9),
+            "attributes": {"flavor": "x"},
+        }
+    ]
+    steps = [
+        {
+            "step": 7,
+            "rank": 0,
+            "time": t0 + 1.0,
+            "wall_ms": 1000.0,
+            "data_wait_ms": 200.0,
+            "step_ms": 800.0,
+        }
+    ]
+    path = tmp_path / "merged.json"
+    trace = merge_chrome_trace(task_events, spans, steps, str(path))
+    assert json.load(open(path)) == trace
+    by_cat = {}
+    for event in trace:
+        by_cat.setdefault(event["cat"], []).append(event)
+    assert {"normal", "span", "step"} <= set(by_cat)
+    # Step phases: sequential layout filling the wall window.
+    wait, step = sorted(by_cat["step"], key=lambda e: e["ts"])
+    assert wait["name"] == "step 7 data_wait"
+    assert step["name"] == "step 7 step"
+    assert wait["ts"] == pytest.approx((t0 + 1.0 - 1.0) * 1e6)
+    assert step["ts"] == pytest.approx(wait["ts"] + wait["dur"])
+    assert step["dur"] == pytest.approx(800e3)
+    assert wait["tid"] == "rank 0" and wait["pid"] == "steps"
+
+
 def test_dashboard_endpoints(rt_session):
     rt = rt_session
     import socket
@@ -132,6 +322,104 @@ def test_dashboard_endpoints(rt_session):
         assert "dash_metric 2.0" in prom
     finally:
         dash.stop()
+
+
+def test_histogram_boundaries_buckets_and_percentiles(rt_session):
+    """Satellite: declared boundaries are real — the head buckets
+    observations (cumulative le_* counts) and reports p50/p95/p99
+    from its sample reservoir."""
+    rt = rt_session
+    from ray_tpu.util.metrics import Histogram, metrics_summary
+
+    lat = Histogram(
+        "bucketed_ms", boundaries=[10, 100, 1000], tag_keys=("op",)
+    )
+    for v in (5.0, 50.0, 50.0, 500.0, 2000.0):
+        lat.observe(v, tags={"op": "rpc"})
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        hist = metrics_summary().get("bucketed_ms", {})
+        if hist.get("count") == 5:
+            break
+        time.sleep(0.2)
+    assert hist["count"] == 5
+    assert hist["buckets"] == {
+        "le_10": 1,
+        "le_100": 3,
+        "le_1000": 4,
+        "inf": 5,
+    }
+    assert hist["p50"] == 50.0
+    assert hist["p95"] == 2000.0
+    assert hist["p99"] == 2000.0
+    # Per-tag buckets too, and no internal reservoir keys on the wire.
+    tagged = hist["by_tags"]["op=rpc"]
+    assert tagged["buckets"]["inf"] == 5
+    assert not any(k.startswith("_") for k in hist)
+    assert not any(k.startswith("_") for k in tagged)
+
+
+def test_metrics_buffer_resets_on_shutdown():
+    """Satellite: the _Buffer singleton + flusher thread die with
+    ray_tpu.shutdown(); re-init binds a fresh buffer to the new
+    session instead of leaking records at the dead one."""
+    import ray_tpu as rt
+    from ray_tpu.util.metrics import Counter, _Buffer, metrics_summary
+
+    rt.init(num_cpus=2)
+    try:
+        Counter("lifecycle_counter").inc(1.0)
+        first = _Buffer.get()
+        assert first.thread.is_alive()
+    finally:
+        rt.shutdown()
+    assert _Buffer._instance is None
+    first.thread.join(timeout=5)
+    assert not first.thread.is_alive()
+
+    rt.init(num_cpus=2)
+    try:
+        second = _Buffer.get()
+        assert second is not first
+        Counter("lifecycle_counter").inc(41.0)
+        deadline = time.time() + 10
+        total = None
+        while time.time() < deadline:
+            total = (
+                metrics_summary()
+                .get("lifecycle_counter", {})
+                .get("total")
+            )
+            if total == 41.0:
+                break
+            time.sleep(0.2)
+        # Fresh cluster: only the post-re-init increment exists.
+        assert total == 41.0
+    finally:
+        rt.shutdown()
+
+
+def test_metrics_flush_raises_without_session():
+    """Satellite: an explicit flush() surfaces delivery failure
+    (RayTpuError) instead of silently swallowing it; the records
+    stay buffered for a later retry."""
+    import ray_tpu.exceptions as exc
+    from ray_tpu.util.metrics import _Buffer, flush
+
+    _Buffer.reset()  # known-clean start regardless of test order
+    buf = _Buffer.get()
+    try:
+        buf.push(("counter", "orphan_metric", 1.0, ()))
+        with pytest.raises(exc.RayTpuError):
+            flush()
+        with buf.records_lock:
+            buffered = [
+                r for _, batch in buf._sealed for r in batch
+            ]
+        assert buffered, "failed flush must keep the batch, not drop"
+    finally:
+        _Buffer.reset()
 
 
 def test_event_stats_per_handler_timing(rt_session):
