@@ -151,6 +151,14 @@ class Config:
     #: time exceeds the cluster p50 by this factor is a straggler.
     doctor_hung_task_s: float = 60.0
     doctor_straggler_threshold: float = 1.5
+    #: Seconds between head metric-table snapshots appended to the
+    #: bounded time-series ring (`/api/timeseries`); 0 disables the
+    #: snapshot loop (kill switch: RT_metrics_timeseries_interval_s=0,
+    #: the history analog of RT_flight_recorder_enabled).
+    metrics_timeseries_interval_s: float = 5.0
+    #: Snapshots retained in the head time-series ring (oldest evict
+    #: first; 720 x 5 s = a one-hour window by default).
+    metrics_timeseries_max_snapshots: int = 720
 
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`

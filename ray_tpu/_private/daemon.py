@@ -374,6 +374,14 @@ class NodeDaemon:
         self._step_records: deque = deque(
             maxlen=config.task_events_max_buffer
         )
+        # Head time-series ring: periodic compacted snapshots of the
+        # metric table so p50/p99 TRENDS survive past the live
+        # reservoir (`/api/timeseries`, `ray_tpu metrics snapshot`).
+        from .timeseries import TimeSeriesStore
+
+        self._timeseries = TimeSeriesStore(
+            config.metrics_timeseries_max_snapshots
+        )
         # This process's flight recorder obeys the cluster config
         # (env RT_flight_recorder_enabled already applied at import).
         from .flight_recorder import configure as _flight_configure
@@ -474,6 +482,7 @@ class NodeDaemon:
             "request_resources",
             "metrics_record",
             "metrics_summary",
+            "metrics_timeseries",
             "event_stats",
             "profile_worker",
             # flight recorder / stall doctor (all nodes; diagnose and
@@ -606,6 +615,14 @@ class NodeDaemon:
             target=self._maintenance_loop, daemon=True,
             name=f"maint:{self.node_id.hex()[:8]}",
         ).start()
+        if (
+            self.is_head
+            and self.config.metrics_timeseries_interval_s > 0
+        ):
+            threading.Thread(
+                target=self._timeseries_loop, daemon=True,
+                name=f"tsdb:{self.node_id.hex()[:8]}",
+            ).start()
         if self.config.log_to_driver:
             threading.Thread(
                 target=self._log_monitor_loop, daemon=True,
@@ -4586,6 +4603,83 @@ class NodeDaemon:
             out[name] = entry
         return {"metrics": out}
 
+    def _timeseries_loop(self) -> None:
+        """Head-only: append a compacted metric-table snapshot to the
+        bounded time-series ring every interval. Snapshots are cheap
+        (scalars per series, no reservoirs) and the ring is bounded,
+        so this loop costs O(series) per tick forever."""
+        interval = self.config.metrics_timeseries_interval_s
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                self._timeseries_snapshot()
+            except Exception:
+                # A malformed record set must not kill history for
+                # the daemon's lifetime; the next tick retries.
+                pass
+
+    def _timeseries_snapshot(self) -> None:
+        """Build + append one snapshot: the compacted metric table
+        plus the synthetic per-job goodput series (so 'when did
+        goodput drop' is answerable from history, not just 'what is
+        it now')."""
+        from .step_telemetry import goodput_from_records
+        from .timeseries import compact_summary
+
+        snapshot = compact_summary(
+            self._h_metrics_summary(None, {})["metrics"]
+        )
+        with self._lock:
+            step_records = list(self._step_records)
+        goodput = goodput_from_records(step_records)
+        if goodput:
+            by_tags = {
+                f"job={job}": {"value": row["goodput"]}
+                for job, row in goodput.items()
+            }
+            # Top-level scalar = the job that REPORTED most recently
+            # (not the one whose first record arrived last): with a
+            # finished job B and a still-training job A, the scalar
+            # must keep tracking A.
+            latest_job = ""
+            for rec in reversed(step_records):
+                job = str(rec.get("job", ""))
+                if job in goodput:
+                    latest_job = job
+                    break
+            row = goodput.get(
+                latest_job, next(iter(goodput.values()))
+            )
+            snapshot["rt_goodput_fraction"] = {
+                "kind": "gauge",
+                "value": row["goodput"],
+                "by_tags": by_tags,
+            }
+        self._timeseries.append(snapshot)
+
+    def _h_metrics_timeseries(self, conn, msg):
+        """Query the head's snapshot ring: optional `name` filters to
+        one series, `since` (unix seconds) to newer-than, `limit`
+        keeps the newest N. Worker nodes forward to the head."""
+        if not self.is_head:
+            fwd = {
+                k: msg[k]
+                for k in ("name", "since", "limit")
+                if k in msg
+            }
+            return self.head.call(
+                "metrics_timeseries", timeout=30.0, **fwd
+            )
+        return {
+            "snapshots": self._timeseries.query(
+                name=msg.get("name"),
+                since=float(msg.get("since", 0.0) or 0.0),
+                limit=int(msg.get("limit", 0) or 0),
+            ),
+            "interval_s": self.config.metrics_timeseries_interval_s,
+            "max_snapshots": self._timeseries.max_snapshots,
+        }
+
     def _h_task_event(self, conn, msg):
         """Workers report state events for direct-transport tasks
         (the daemon never sees those specs; reference: workers batch
@@ -4737,7 +4831,14 @@ class NodeDaemon:
         limit = int(msg.get("limit", 1000))
         with self._lock:
             records = list(self._step_records)[-limit:]
-        reply = {"summary": _summarize_steps(records)}
+        from .step_telemetry import goodput_from_records
+
+        summary = _summarize_steps(records)
+        # Per-JOB goodput over the same window (summary stats are
+        # most-recent-job only; goodput keeps every job apart so
+        # concurrent tenants each get their own fraction).
+        summary["goodput"] = goodput_from_records(records)
+        reply = {"summary": summary}
         if msg.get("records"):
             # Raw per-step dicts are opt-in: summary readers (the
             # dashboard's steady-state poll among them) shouldn't pay
@@ -4802,6 +4903,11 @@ class NodeDaemon:
         with self._lock:
             step_records = list(self._step_records)[-limit:]
         steps = _summarize_steps(step_records)
+        from .step_telemetry import goodput_from_records
+
+        # Per-job goodput classification over the same window the
+        # straggler stats use, so both surfaces describe one cluster.
+        steps["goodput"] = goodput_from_records(step_records)
         workers = steps.get("workers", {})
         if len(workers) >= 2:
             medians = sorted(

@@ -38,6 +38,7 @@ __all__ = [
     "timed_iter",
     "report_step",
     "steps_to_chrome_trace",
+    "goodput_from_records",
 ]
 
 _tl = threading.local()
@@ -210,6 +211,85 @@ def steps_to_chrome_trace(records) -> list:
                 cursor_us, step_start_us + wall_ms * 1e3
             )
     return trace
+
+
+#: Wait phases that classify as stall time in goodput accounting.
+_STALL_PHASES = ("data_wait_ms", "h2d_ms", "ckpt_block_ms")
+
+
+def goodput_from_records(records) -> Dict[str, dict]:
+    """Classify each job's reported step wall clock into productive
+    vs stall time (PAPERS: the Gemma-on-TPU serving/fine-tuning
+    comparison hinges on sustained-throughput accounting — goodput is
+    its training-side analog).
+
+    Per job: ``wall_ms`` = sum of non-warmup step walls, split into
+    ``productive_ms`` (step compute), per-phase ``stalls``
+    (`data_wait`/`h2d`/`ckpt_block`) and ``idle_ms`` (wall the phases
+    don't attribute). By construction productive + stall + idle == wall
+    exactly: phases are capped at the wall they sit inside (the same
+    cap `report_step` applies), so the goodput fraction is a true
+    fraction of measured wall clock, never >1 and never negative.
+
+    Warmup records (session setup) and records with no wall anchor
+    (hand-rolled `report_step(step_ms=...)` without `wall_ms`) carry
+    no usable wall interval and are skipped; `steps` counts what was
+    actually classified.
+    """
+    jobs: Dict[str, dict] = {}
+    for rec in records:
+        if rec.get("warmup"):
+            continue
+        try:
+            wall = float(rec.get("wall_ms", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if wall <= 0.0:
+            continue
+        job = str(rec.get("job", ""))
+        row = jobs.setdefault(
+            job,
+            {
+                "steps": 0,
+                "wall_ms": 0.0,
+                "productive_ms": 0.0,
+                "stall_ms": 0.0,
+                "idle_ms": 0.0,
+                "stalls": {p: 0.0 for p in _STALL_PHASES},
+            },
+        )
+        stall = 0.0
+        for phase in _STALL_PHASES:
+            try:
+                ms = float(rec.get(phase, 0.0) or 0.0)
+            except (TypeError, ValueError):
+                ms = 0.0
+            # A stall inside this step's wall cannot exceed the wall
+            # REMAINING after the stalls already counted.
+            ms = max(0.0, min(ms, wall - stall))
+            row["stalls"][phase] += ms
+            stall += ms
+        try:
+            productive = float(rec.get("step_ms", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            productive = 0.0
+        productive = max(0.0, min(productive, wall - stall))
+        row["steps"] += 1
+        row["wall_ms"] += wall
+        row["productive_ms"] += productive
+        row["stall_ms"] += stall
+        row["idle_ms"] += wall - stall - productive
+    for row in jobs.values():
+        wall = row["wall_ms"]
+        row["goodput"] = round(
+            row["productive_ms"] / wall if wall > 0 else 0.0, 4
+        )
+        for key in ("wall_ms", "productive_ms", "stall_ms", "idle_ms"):
+            row[key] = round(row[key], 3)
+        row["stalls"] = {
+            p: round(v, 3) for p, v in row["stalls"].items()
+        }
+    return jobs
 
 
 def report_step(

@@ -250,6 +250,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?seq": (int, type(None)),
     },
     "metrics_summary": {},
+    "metrics_timeseries": {
+        "?name": (str, type(None)),
+        "?since": _num,
+        "?limit": int,
+    },
     "event_stats": {},
     # flight recorder / doctor (rings are pulled, never pushed)
     "flight_recorder": {

@@ -10,7 +10,11 @@ Endpoints: /            — HTML summary page (auto-refreshing)
            /api/summary — state summary
            /api/nodes | /api/actors | /api/tasks | /api/objects
            /api/placement_groups | /api/resources | /api/metrics
-           /metrics     — Prometheus exposition text
+           /api/serve   — per-deployment serving stats (p50/p99,
+                          in-flight, queue depth)
+           /api/timeseries?name=...&since=...&limit=...
+                        — head snapshot-ring history
+           /metrics     — Prometheus exposition text (0.0.4)
 """
 
 from __future__ import annotations
@@ -56,7 +60,7 @@ _PAGE = """<!doctype html>
 </main>
 <script>
 const TABS = ["nodes","actors","tasks","objects","placement_groups",
-              "resources","metrics","spans","steps","doctor"];
+              "resources","metrics","serve","spans","steps","doctor"];
 let active = "nodes";
 const $ = (id) => document.getElementById(id);
 function tabs() {
@@ -105,7 +109,8 @@ async function tick() {
     const data = await j("/api/" + tab);
     if (tab !== active) return;
     $("view").innerHTML = table(
-      tab === "resources" || tab === "metrics" || tab === "steps"
+      tab === "resources" || tab === "metrics" || tab === "steps" ||
+      tab === "serve"
         ? Object.entries(data).map(([k,v]) => ({name:k, ...(
             typeof v === "object" ? v : {value:v})}))
         : data);
@@ -118,39 +123,6 @@ tabs(); tick(); setInterval(tick, 2000);
 
 
 _UNKNOWN_API = object()
-
-
-def _prometheus_text(metrics: dict) -> str:
-    lines = []
-    for name, entry in metrics.items():
-        kind = entry.get("kind")
-        safe = name.replace(".", "_").replace("-", "_")
-        if entry.get("description"):
-            lines.append(f"# HELP {safe} {entry['description']}")
-        if kind == "counter":
-            lines.append(f"# TYPE {safe} counter")
-            value_key = "total"
-        elif kind == "gauge":
-            lines.append(f"# TYPE {safe} gauge")
-            value_key = "value"
-        else:
-            lines.append(f"# TYPE {safe} summary")
-            lines.append(f"{safe}_count {entry.get('count', 0)}")
-            lines.append(f"{safe}_sum {entry.get('sum', 0.0)}")
-            continue
-        by_node = entry.get("by_node")
-        if by_node:
-            # Core runtime metrics: ONLY per-node labeled series
-            # (reference exports per-node series through each node's
-            # metrics agent). No unlabeled cluster line — it would
-            # double-count under PromQL sum().
-            for node, value in sorted(by_node.items()):
-                lines.append(
-                    f'{safe}{{node="{node}"}} {value}'
-                )
-        else:
-            lines.append(f"{safe} {entry.get(value_key, 0.0)}")
-    return "\n".join(lines) + "\n"
 
 
 class Dashboard:
@@ -207,6 +179,7 @@ class Dashboard:
                 "available": ray_tpu.available_resources(),
             },
             "metrics": self._metrics,
+            "serve": self._serve,
             "spans": self._spans,
             "steps": self._steps,
             "doctor": self._doctor,
@@ -223,6 +196,34 @@ class Dashboard:
         from .util.metrics import metrics_summary
 
         return metrics_summary()
+
+    @staticmethod
+    def _serve():
+        """Per-deployment serving observability: replica/ingress
+        state from the controller merged with the head's request-path
+        histograms (p50/p99, counts, in-flight, queue depth). Empty
+        when serve was never started — the dashboard must work on
+        training-only clusters."""
+        from .serve.api import status_detail
+
+        return status_detail()
+
+    @staticmethod
+    def _timeseries(query: str):
+        """/api/timeseries?name=...&since=...&limit=... — the head's
+        bounded snapshot ring (see util.metrics.metrics_timeseries)."""
+        from urllib.parse import parse_qs
+
+        from .util.metrics import metrics_timeseries
+
+        params = {
+            k: v[0] for k, v in parse_qs(query or "").items()
+        }
+        return metrics_timeseries(
+            name=params.get("name"),
+            since=float(params.get("since", 0.0) or 0.0),
+            limit=int(params.get("limit", 0) or 0),
+        )
 
     @staticmethod
     def _spans():
@@ -260,6 +261,14 @@ class Dashboard:
         return {
             "max_skew_ms": summary.get("max_skew_ms", 0.0),
             "steps_observed": summary.get("steps_observed", 0),
+            # Per-job goodput classification (productive vs
+            # data_wait/h2d/ckpt_block/idle) over the same window.
+            **{
+                f"goodput {job or 'job'}": row
+                for job, row in sorted(
+                    summary.get("goodput", {}).items()
+                )
+            },
             **{
                 f"rank {rank}": row
                 for rank, row in sorted(
@@ -327,6 +336,12 @@ class Dashboard:
                 self._profile(query), default=str
             ).encode()
             return 200, payload, "application/json"
+        if path.startswith("/api/timeseries"):
+            _, _, query = path.partition("?")
+            payload = json.dumps(
+                self._timeseries(query), default=str
+            ).encode()
+            return 200, payload, "application/json"
         if path.startswith("/api/"):
             kind = path[len("/api/") :].strip("/")
             data = self._collect(kind)
@@ -342,9 +357,11 @@ class Dashboard:
                 "application/json",
             )
         if path == "/metrics":
+            from .util.prometheus import render_prometheus
+
             return (
                 200,
-                _prometheus_text(self._metrics()).encode(),
+                render_prometheus(self._metrics()).encode(),
                 "text/plain; version=0.0.4",
             )
         if path in ("/", "/index.html"):

@@ -1,4 +1,4 @@
-"""The RT001–RT008 distributed-correctness passes.
+"""The RT001–RT009 distributed-correctness passes.
 
 Each rule is one bug class ray_tpu has actually shipped (or nearly
 shipped — see ADVICE.md for the originals) generalized into a
@@ -17,6 +17,7 @@ leaves the rest of Python alone.
 | RT006 | hardcoded namespace="default" outside the session module     |
 | RT007 | bare/swallowed exceptions in daemon RPC handlers             |
 | RT008 | cross-process wait()/join() with no timeout                  |
+| RT009 | metric names/labels violating the Prometheus convention      |
 
 Hooks a rule may define (all optional): ``on_call``, ``on_compare``,
 ``on_except``, ``on_assign``, ``on_keyword``, ``on_functiondef`` —
@@ -26,6 +27,7 @@ each ``(node, ctx) -> iterable of (message, anchor_node | None)``.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable, Optional, Tuple
 
 from .lint import LintContext, _dotted
@@ -411,6 +413,74 @@ class MissingWaitTimeout(Rule):
         )
 
 
+class MetricNamingConvention(Rule):
+    """RT009: exported metric series must stay Prometheus-legal and
+    follow the documented convention (README "Metrics export"):
+    snake_case ``^[a-z][a-z0-9_]*$`` names, counters ending in
+    ``_total``, snake_case label keys. Dots/dashes only survive
+    because the exposition layer sanitizes them — two sanitized-equal
+    names would silently merge into one series, so the linter rejects
+    them at the declaration site instead. Scope: metrics DECLARED in
+    the package (tests may name throwaway metrics freely)."""
+
+    id = "RT009"
+    title = "metric name/label violates the naming convention"
+    exclude = ("tests/",)
+
+    _CONSTRUCTORS = ("Counter", "Gauge", "Histogram")
+    _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+    def _literal_name(self, node: ast.Call):
+        """The metric name argument when it is a string literal:
+        first positional or `name=` keyword."""
+        if node.args and isinstance(node.args[0], ast.Constant):
+            if isinstance(node.args[0].value, str):
+                return node.args[0].value, node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    return kw.value.value, kw.value
+        return None, None
+
+    def on_call(self, node: ast.Call, ctx: LintContext) -> Iterable[Hit]:
+        kind = _terminal_name(node.func)
+        if kind not in self._CONSTRUCTORS:
+            return
+        name, anchor = self._literal_name(node)
+        if name is None:
+            return
+        if not self._NAME_RE.match(name):
+            yield (
+                f"metric name {name!r} violates the convention "
+                "^[a-z][a-z0-9_]*$ (sanitized-equal names merge into "
+                "one exported series)",
+                anchor,
+            )
+        elif kind == "Counter" and not name.endswith("_total"):
+            yield (
+                f"counter {name!r} must end in `_total` (Prometheus "
+                "counter convention; rate() readers depend on it)",
+                anchor,
+            )
+        for kw in node.keywords:
+            if kw.arg != "tag_keys":
+                continue
+            if not isinstance(kw.value, (ast.Tuple, ast.List)):
+                continue
+            for element in kw.value.elts:
+                if not isinstance(element, ast.Constant):
+                    continue
+                label = element.value
+                if isinstance(label, str) and not self._NAME_RE.match(
+                    label
+                ):
+                    yield (
+                        f"label key {label!r} on metric {name!r} "
+                        "violates the convention ^[a-z][a-z0-9_]*$",
+                        element,
+                    )
+
+
 ALL_RULES = [
     BlockingGetInActor(),
     PayloadEqualityDedup(),
@@ -420,4 +490,5 @@ ALL_RULES = [
     HardcodedNamespace(),
     SwallowedHandlerError(),
     MissingWaitTimeout(),
+    MetricNamingConvention(),
 ]

@@ -466,6 +466,82 @@ def cmd_serve_shutdown(args) -> None:
     print("serve shut down")
 
 
+def cmd_metrics_scrape(args) -> None:
+    """`ray_tpu metrics scrape` — one Prometheus text-format scrape of
+    the live cluster (exactly the dashboard's /metrics payload, no
+    dashboard required; pipe it to a file or promtool)."""
+    _connect(args)
+    from ..util.metrics import metrics_summary
+    from ..util.prometheus import render_prometheus
+
+    sys.stdout.write(render_prometheus(metrics_summary()))
+
+
+def cmd_metrics_snapshot(args) -> None:
+    """`ray_tpu metrics snapshot` — dump the head's time-series ring
+    (bounded history of periodic metric snapshots) as JSON; --name /
+    --since / --limit filter server-side."""
+    _connect(args)
+    from ..util.metrics import metrics_timeseries
+
+    snapshots = metrics_timeseries(
+        name=args.name, since=args.since, limit=args.limit
+    )
+    print(json.dumps(snapshots, indent=2, default=str))
+
+
+#: `ray_tpu state ls` kinds -> state-API callables (pgs is the short
+#: alias the reference CLI uses for placement groups).
+_STATE_KINDS = ("nodes", "actors", "tasks", "objects", "pgs")
+
+
+def cmd_state_ls(args) -> None:
+    """`ray_tpu state ls {nodes,actors,tasks,objects,pgs}` — the
+    state API as a CLI, following the lint/check output contract:
+    `--json` emits machine-readable rows, exit code 0 on success and
+    2 on usage/connection errors (argparse and _resolve_address
+    already exit 2). Tasks list newest-first under --limit."""
+    _connect(args)
+    from ..util import state
+
+    fetchers = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": lambda: state.list_tasks(limit=args.limit),
+        "objects": lambda: state.list_objects(limit=args.limit),
+        "pgs": state.list_placement_groups,
+    }
+    rows = fetchers[args.kind]()
+    if args.as_json:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    if not rows:
+        print(f"no {args.kind}")
+        return
+    # Human table: union of keys, one row per entry, wide cells
+    # JSON-ified (the SPA's table() in dashboard.py, terminal-ized).
+    keys = []
+    for row in rows:
+        for key in row:
+            if key not in keys:
+                keys.append(key)
+
+    def cell(value) -> str:
+        if isinstance(value, (dict, list)):
+            value = json.dumps(value, default=str)
+        text = str(value if value is not None else "")
+        return text if len(text) <= 40 else text[:37] + "..."
+
+    table = [[cell(row.get(k)) for k in keys] for row in rows]
+    widths = [
+        max(len(keys[i]), *(len(r[i]) for r in table))
+        for i in range(len(keys))
+    ]
+    print("  ".join(k.ljust(w) for k, w in zip(keys, widths)))
+    for r in table:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+
 def cmd_doctor(args) -> None:
     """`ray_tpu doctor` — the stall doctor. One verdict over head
     task state, per-worker in-flight views, step telemetry, and
@@ -526,7 +602,7 @@ def cmd_doctor(args) -> None:
 
 def cmd_lint(args) -> None:
     """`ray_tpu lint [paths]` — the framework-aware distributed-
-    correctness linter (devtools/lint.py, rules RT001-RT008). Runs
+    correctness linter (devtools/lint.py, rules RT001-RT009). Runs
     offline on source trees; no cluster connection."""
     from ..devtools.lint import main as lint_main
 
@@ -717,6 +793,56 @@ def main(argv=None) -> None:
     p_sdown.add_argument("--address")
     p_sdown.set_defaults(fn=cmd_serve_shutdown)
 
+    p_metrics = sub.add_parser(
+        "metrics",
+        help="metrics export: Prometheus scrape / history snapshot",
+    )
+    metrics_sub = p_metrics.add_subparsers(
+        dest="metrics_cmd", required=True
+    )
+    p_scrape = metrics_sub.add_parser(
+        "scrape",
+        help="print one Prometheus text-format scrape of the cluster",
+    )
+    p_scrape.add_argument("--address")
+    p_scrape.set_defaults(fn=cmd_metrics_scrape)
+    p_snap = metrics_sub.add_parser(
+        "snapshot",
+        help="dump the head's bounded metrics time-series ring (JSON)",
+    )
+    p_snap.add_argument("--address")
+    p_snap.add_argument(
+        "--name", help="filter to one metric series"
+    )
+    p_snap.add_argument(
+        "--since", type=float, default=0.0,
+        help="only snapshots newer than this unix timestamp",
+    )
+    p_snap.add_argument(
+        "--limit", type=int, default=0,
+        help="keep only the newest N snapshots",
+    )
+    p_snap.set_defaults(fn=cmd_metrics_snapshot)
+
+    p_state = sub.add_parser(
+        "state", help="state API listings (ls subcommand)"
+    )
+    state_sub = p_state.add_subparsers(dest="state_cmd", required=True)
+    p_sls = state_sub.add_parser(
+        "ls", help="list cluster state entities"
+    )
+    p_sls.add_argument("kind", choices=list(_STATE_KINDS))
+    p_sls.add_argument("--address")
+    p_sls.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit rows as JSON (CI/scripting mode)",
+    )
+    p_sls.add_argument(
+        "--limit", type=int, default=1000,
+        help="max rows for tasks/objects (tasks are newest-first)",
+    )
+    p_sls.set_defaults(fn=cmd_state_ls)
+
     p_doc = sub.add_parser(
         "doctor",
         help="stall doctor: stragglers, hung tasks (with stacks), "
@@ -750,7 +876,7 @@ def main(argv=None) -> None:
 
     p_lint = sub.add_parser(
         "lint",
-        help="distributed-correctness linter (rules RT001-RT008)",
+        help="distributed-correctness linter (rules RT001-RT009)",
     )
     p_lint.add_argument(
         "paths", nargs="*", help="files/dirs to lint (default: ray_tpu)"

@@ -9,8 +9,10 @@ from .api import (
     proxy_ports,
     start,
     status,
+    status_detail,
 )
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .observability import get_request_id
 from .deployment import (
     Application,
     AutoscalingConfig,
@@ -34,6 +36,8 @@ __all__ = [
     "proxy_ports",
     "start",
     "status",
+    "status_detail",
+    "get_request_id",
     "delete",
     "shutdown",
     "get_app_handle",

@@ -206,9 +206,67 @@ def proxy_ports() -> Dict[str, int]:
 
 
 def status() -> Dict[str, Any]:
+    """Live per-app state: route prefix plus, per deployment, replica
+    count, version, in-flight request count (router-reported), and —
+    when request-path metrics have reached the head — p50/p99 handler
+    latency, request/error totals and derived queue depth. The raw
+    shape under ``{app: {"deployments": {name: {...}}}}`` is stable;
+    metric keys appear once traffic has flowed."""
     rt = _rt()
     controller = _get_or_create_controller()
-    return rt.get(controller.status.remote(), timeout=30)
+    base = rt.get(controller.status.remote(), timeout=30)
+    return _merge_request_metrics(base)
+
+
+def _merge_request_metrics(base: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold the head's serve histograms (observability.py) into the
+    controller's structural status. Best-effort: a head that has seen
+    no serve metrics yet (or an uninitialized summary read) leaves the
+    structural status intact."""
+    from .observability import deployment_snapshot
+
+    try:
+        from ..util.metrics import metrics_summary
+
+        snapshot = deployment_snapshot(metrics_summary())
+    except Exception:
+        return base
+    for app, state in base.items():
+        for name, dep in (state.get("deployments") or {}).items():
+            row = snapshot.get((app, name))
+            if not row:
+                continue
+            dep.update(row)
+            # Queue depth = routed-but-not-yet-executing: requests a
+            # router has sent that no replica is running yet (actor
+            # mailbox + wire). Derived, so the proxy/router never pays
+            # a queue-tracking RPC.
+            dep["queue_depth"] = max(
+                0.0,
+                float(dep.get("in_flight", 0.0))
+                - float(row.get("executing", 0.0)),
+            )
+    return base
+
+
+def status_detail() -> Dict[str, Any]:
+    """`/api/serve` payload: `status()` flattened to one row per
+    deployment (app/deployment in the row), empty when serve was
+    never started on this cluster."""
+    import ray_tpu as rt
+
+    try:
+        rt.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except Exception:
+        return {}
+    out: Dict[str, Any] = {}
+    for app, state in status().items():
+        for name, dep in (state.get("deployments") or {}).items():
+            out[f"{app}/{name}"] = {
+                "route_prefix": state.get("route_prefix"),
+                **dep,
+            }
+    return out
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

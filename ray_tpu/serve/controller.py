@@ -271,7 +271,21 @@ class ServeController:
                 )
             }
 
+    def _in_flight(self, app: str, name: str, now: float) -> float:
+        """Requests currently routed-but-unresolved for a deployment:
+        the sum of every handle's freshly-reported ongoing count
+        (stale handles — exited drivers — age out of the sum). Caller
+        holds the lock."""
+        return sum(
+            value
+            for ts, value in self._metrics.get(
+                (app, name), {}
+            ).values()
+            if now - ts < 2.0
+        )
+
     def status(self) -> dict:
+        now = time.time()
         with self._lock:
             return {
                 app: {
@@ -282,6 +296,9 @@ class ServeController:
                                 self._replicas.get((app, name), [])
                             ),
                             "version": spec["version"],
+                            "in_flight": self._in_flight(
+                                app, name, now
+                            ),
                         }
                         for name, spec in state["deployments"].items()
                     },
@@ -315,12 +332,7 @@ class ServeController:
                     cfg = spec.get("autoscaling")
                     if not cfg:
                         continue
-                    reports = self._metrics.get((app, name), {})
-                    ongoing = sum(
-                        value
-                        for ts, value in reports.values()
-                        if now - ts < 2.0
-                    )
+                    ongoing = self._in_flight(app, name, now)
                     current = len(self._replicas.get((app, name), []))
                     desired = max(
                         cfg["min_replicas"],

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextvars
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional
 
@@ -83,7 +84,17 @@ class _ModelMultiplexWrapper:
                     break
             pending.wait(timeout=600)
         try:
+            # The swap cost a cold-model request pays before its
+            # handler runs — per-deployment histogram + flight-recorder
+            # event, attributed to the request that triggered the load
+            # (observability.current_request_context).
+            from .observability import observe_model_load
+
+            t0 = time.perf_counter()
             model = self._load_fn(self._owner, model_id)
+            observe_model_load(
+                model_id, (time.perf_counter() - t0) * 1e3
+            )
             evicted = None
             with self._lock:
                 if len(self._models) >= self._max:

@@ -1,0 +1,453 @@
+"""Serve request-path observability.
+
+Reference: python/ray/serve/_private/metrics_utils.py + the request
+context module — every request carries an id, and the proxy, router,
+replica and multiplex layers each record their segment of its life
+into per-deployment histograms:
+
+  serve_http_request_latency_ms   proxy: end-to-end HTTP time
+  serve_router_routing_ms         handle: replica selection time
+  serve_queue_wait_ms             replica: send -> execution start
+  serve_request_latency_ms        replica: handler execution time
+  serve_model_load_ms             multiplex: model swap (load) time
+  serve_requests_total            replica: completions by outcome
+  serve_http_requests_total       proxy: completions by status class
+  serve_replica_executing         replica: currently-executing gauge
+
+All ride the existing metrics pipe (util/metrics) to the head, so
+they show up in `metrics_summary()`, the Prometheus endpoint and the
+time-series ring with {app, deployment, ...} labels; the flight
+recorder additionally keeps the most recent requests per process
+(kinds ``serve.http`` / ``serve.handle`` / ``serve.model_load``)
+with the request id, so `ray_tpu doctor`'s ring digests show serve
+traffic next to RPC traffic.
+
+Request ids: the proxy honors an incoming ``x-request-id`` header or
+mints one; bare handle calls mint one per request. The id propagates
+proxy -> router -> replica -> multiplex via the request-context dict
+the router ships with every replica call, and comes back to HTTP
+callers as the ``x-request-id`` response header.
+
+Kill switch: ``RT_serve_request_metrics_enabled=0`` disables every
+histogram/counter observation on this process (the request-path
+analog of ``RT_flight_recorder_enabled``); request ids still
+propagate — they cost one uuid per request and make error logs
+correlatable even with metrics off.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+__all__ = [
+    "REQUEST_ID_HEADER",
+    "new_request_id",
+    "new_request_context",
+    "request_context",
+    "current_request_context",
+    "get_request_id",
+    "reset_request_context",
+    "observe_http",
+    "observe_routing",
+    "observe_queue_wait",
+    "observe_handler",
+    "observe_model_load",
+    "replica_executing",
+    "deployment_snapshot",
+]
+
+REQUEST_ID_HEADER = "x-request-id"
+
+#: Latency bucket boundaries (ms) shared by every serve histogram:
+#: sub-ms RPC floors through multi-second model loads.
+LATENCY_BUCKETS_MS = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+def _enabled() -> bool:
+    raw = os.environ.get("RT_serve_request_metrics_enabled", "1")
+    return raw.lower() in ("1", "true", "yes")
+
+
+_ENABLED = _enabled()
+
+#: Replica-side context of the request being handled; multiplex reads
+#: it for model-load attribution, user code may read the request id.
+_request_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_context", default=None
+)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_request_context(
+    app: str,
+    deployment: str,
+    request_id: Optional[str] = None,
+    trace: Optional[dict] = None,
+) -> dict:
+    """The dict the router ships with every replica call: identity +
+    the send timestamp the replica turns into queue wait."""
+    ctx = {
+        "request_id": request_id or new_request_id(),
+        "app": app,
+        "deployment": deployment,
+        "sent_ts": time.time(),
+    }
+    if trace:
+        ctx["trace"] = trace
+    return ctx
+
+
+def request_context(ctx: Optional[dict]):
+    """Set the replica-side request context; returns the reset
+    token."""
+    return _request_ctx.set(ctx)
+
+
+def reset_request_context(token) -> None:
+    _request_ctx.reset(token)
+
+
+def current_request_context() -> Optional[dict]:
+    """Context of the request being handled (replica-side); None
+    outside a serve request."""
+    return _request_ctx.get()
+
+
+def get_request_id() -> str:
+    """Id of the serve request being handled (usable in user handler
+    code for log correlation); "" outside a serve request."""
+    ctx = _request_ctx.get()
+    return str(ctx.get("request_id", "")) if ctx else ""
+
+
+# ---------------------------------------------------------------------
+# lazy metric singletons (one instance per (name) per process; the
+# metrics pipe batches records, so per-request cost is a tuple append)
+# ---------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_metrics: Dict[str, object] = {}
+
+
+def _histogram(name: str, description: str, tag_keys: tuple):
+    from ..util.metrics import Histogram
+
+    with _metrics_lock:
+        metric = _metrics.get(name)
+        if metric is None:
+            metric = _metrics[name] = Histogram(
+                name,
+                description=description,
+                boundaries=LATENCY_BUCKETS_MS,
+                tag_keys=tag_keys,
+            )
+    return metric
+
+
+def _counter(name: str, description: str, tag_keys: tuple):
+    from ..util.metrics import Counter
+
+    with _metrics_lock:
+        metric = _metrics.get(name)
+        if metric is None:
+            metric = _metrics[name] = Counter(
+                name, description=description, tag_keys=tag_keys
+            )
+    return metric
+
+
+def _gauge(name: str, description: str, tag_keys: tuple):
+    from ..util.metrics import Gauge
+
+    with _metrics_lock:
+        metric = _metrics.get(name)
+        if metric is None:
+            metric = _metrics[name] = Gauge(
+                name, description=description, tag_keys=tag_keys
+            )
+    return metric
+
+
+def _fr(kind: str, name: str, dur_ms: float, extra: dict) -> None:
+    from .._private.flight_recorder import record
+
+    record(kind, name, dur_ms, extra)
+
+
+# ---------------------------------------------------------------------
+# observation hooks (each guarded: observability must never fail a
+# request)
+# ---------------------------------------------------------------------
+
+def observe_http(
+    app: str,
+    deployment: str,
+    route: str,
+    status: int,
+    dur_ms: float,
+    request_id: str,
+) -> None:
+    """Proxy: one completed HTTP request (end-to-end, queueing and
+    streaming included)."""
+    if not _ENABLED:
+        return
+    try:
+        tags = {"app": app, "deployment": deployment}
+        _histogram(
+            "serve_http_request_latency_ms",
+            "End-to-end HTTP request latency at the ingress proxy",
+            ("app", "deployment"),
+        ).observe(dur_ms, tags=tags)
+        _counter(
+            "serve_http_requests_total",
+            "HTTP requests completed at the ingress proxy",
+            ("app", "deployment", "status"),
+        ).inc(1.0, tags={**tags, "status": f"{int(status) // 100}xx"})
+        _fr(
+            "serve.http",
+            f"{app}/{deployment}{route}",
+            dur_ms,
+            {
+                "request_id": request_id,
+                "status": int(status),
+                "error": int(status) >= 500,
+            },
+        )
+    except Exception:
+        pass
+
+
+def observe_routing(app: str, deployment: str, dur_ms: float) -> None:
+    """Handle: time spent choosing a replica (includes any wait for
+    replica membership to appear)."""
+    if not _ENABLED:
+        return
+    try:
+        _histogram(
+            "serve_router_routing_ms",
+            "Replica selection time in the router",
+            ("app", "deployment"),
+        ).observe(dur_ms, tags={"app": app, "deployment": deployment})
+    except Exception:
+        pass
+
+
+def observe_queue_wait(
+    app: str, deployment: str, dur_ms: float
+) -> None:
+    """Replica: router send -> handler start (actor mailbox + wire
+    time; cross-host clock skew makes this approximate off-box)."""
+    if not _ENABLED:
+        return
+    try:
+        _histogram(
+            "serve_queue_wait_ms",
+            "Router-send to handler-start wait per request",
+            ("app", "deployment"),
+        ).observe(
+            max(0.0, dur_ms),
+            tags={"app": app, "deployment": deployment},
+        )
+    except Exception:
+        pass
+
+
+def observe_handler(
+    app: str,
+    deployment: str,
+    method: str,
+    dur_ms: float,
+    error: bool,
+    request_id: str = "",
+) -> None:
+    """Replica: handler execution time + outcome counter."""
+    if not _ENABLED:
+        return
+    try:
+        tags = {"app": app, "deployment": deployment}
+        _histogram(
+            "serve_request_latency_ms",
+            "Handler execution latency per deployment",
+            ("app", "deployment"),
+        ).observe(dur_ms, tags=tags)
+        _counter(
+            "serve_requests_total",
+            "Requests completed by replicas, by outcome",
+            ("app", "deployment", "method", "outcome"),
+        ).inc(
+            1.0,
+            tags={
+                **tags,
+                "method": method,
+                "outcome": "error" if error else "ok",
+            },
+        )
+        _fr(
+            "serve.handle",
+            f"{app}/{deployment}.{method}",
+            dur_ms,
+            {"request_id": request_id, "error": bool(error)},
+        )
+    except Exception:
+        pass
+
+
+def observe_model_load(model_id: str, dur_ms: float) -> None:
+    """Multiplex: one model load (LRU miss). Deployment attribution
+    comes from the request context the replica set around the call;
+    loads outside any request (warmup) land under app=""/deployment=""
+    rather than being dropped."""
+    if not _ENABLED:
+        return
+    try:
+        ctx = current_request_context() or {}
+        app = str(ctx.get("app", ""))
+        deployment = str(ctx.get("deployment", ""))
+        _histogram(
+            "serve_model_load_ms",
+            "Multiplexed model load (swap) time per deployment",
+            ("app", "deployment"),
+        ).observe(dur_ms, tags={"app": app, "deployment": deployment})
+        _fr(
+            "serve.model_load",
+            model_id,
+            dur_ms,
+            {
+                "request_id": str(ctx.get("request_id", "")),
+                "deployment": f"{app}/{deployment}",
+            },
+        )
+    except Exception:
+        pass
+
+
+#: Throttle for the executing gauge: one gauge record per replica per
+#: change is fine at test scale but pure overhead at bench rates —
+#: zero-crossing edges (0 <-> nonzero, both directions) ALWAYS push;
+#: same-sign updates are limited to one per period.
+_GAUGE_MIN_INTERVAL_S = 0.1
+_gauge_last: Dict[tuple, tuple] = {}  # key -> (ts, value)
+
+
+def replica_executing(
+    app: str, deployment: str, replica_id: str, executing: int
+) -> None:
+    """Replica: currently-executing request count. Tagged by replica
+    so concurrent replicas of one deployment don't overwrite each
+    other's gauge — consumers sum across the replica label."""
+    if not _ENABLED:
+        return
+    try:
+        key = (app, deployment, replica_id)
+        now = time.monotonic()
+        last_ts, last_value = _gauge_last.get(key, (0.0, -1))
+        edge = (executing == 0) != (last_value == 0)
+        if not edge and now - last_ts < _GAUGE_MIN_INTERVAL_S:
+            return
+        _gauge_last[key] = (now, executing)
+        _gauge(
+            "serve_replica_executing",
+            "Requests currently executing on a replica",
+            ("app", "deployment", "replica"),
+        ).set(
+            float(executing),
+            tags={
+                "app": app,
+                "deployment": deployment,
+                "replica": replica_id,
+            },
+        )
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------
+# read side: fold the head's metric table into per-deployment rows
+# ---------------------------------------------------------------------
+
+def _tag_dict(flat: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if not flat:
+        return out
+    for part in flat.split("|"):
+        key, _, value = part.partition("=")
+        out[key] = value
+    return out
+
+
+def deployment_snapshot(summary: Dict[str, dict]) -> Dict[tuple, dict]:
+    """Fold a `metrics_summary()` mapping into {(app, deployment):
+    {p50_ms, p99_ms, requests_total, errors_total, executing, ...}} —
+    the request-path half of `serve.status()` / `/api/serve`."""
+    out: Dict[tuple, dict] = {}
+
+    def row(tags: Dict[str, str]) -> Optional[dict]:
+        app = tags.get("app")
+        deployment = tags.get("deployment")
+        if app is None or deployment is None or not deployment:
+            return None
+        return out.setdefault(
+            (app, deployment),
+            {
+                "requests_total": 0.0,
+                "errors_total": 0.0,
+                "executing": 0.0,
+            },
+        )
+
+    latency = summary.get("serve_request_latency_ms", {})
+    for flat, series in (latency.get("by_tags") or {}).items():
+        target = row(_tag_dict(flat))
+        if target is None:
+            continue
+        for stat in ("p50", "p99"):
+            if stat in series:
+                target[f"{stat}_ms"] = series[stat]
+        target["mean_ms"] = round(
+            series.get("sum", 0.0) / series["count"], 3
+        ) if series.get("count") else 0.0
+
+    counts = summary.get("serve_requests_total", {})
+    for flat, series in (counts.get("by_tags") or {}).items():
+        tags = _tag_dict(flat)
+        target = row(tags)
+        if target is None:
+            continue
+        total = float(series.get("total", 0.0) or 0.0)
+        target["requests_total"] += total
+        if tags.get("outcome") == "error":
+            target["errors_total"] += total
+
+    executing = summary.get("serve_replica_executing", {})
+    for flat, series in (executing.get("by_tags") or {}).items():
+        target = row(_tag_dict(flat))
+        if target is None:
+            continue
+        target["executing"] += float(series.get("value", 0.0) or 0.0)
+
+    queue_wait = summary.get("serve_queue_wait_ms", {})
+    for flat, series in (queue_wait.get("by_tags") or {}).items():
+        target = row(_tag_dict(flat))
+        if target is None or not series.get("count"):
+            continue
+        target["queue_wait_p50_ms"] = series.get("p50", 0.0)
+
+    model_load = summary.get("serve_model_load_ms", {})
+    for flat, series in (model_load.get("by_tags") or {}).items():
+        target = row(_tag_dict(flat))
+        if target is None or not series.get("count"):
+            continue
+        target["model_loads"] = series.get("count", 0)
+        target["model_load_p50_ms"] = series.get("p50", 0.0)
+    return out

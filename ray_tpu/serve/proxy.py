@@ -136,6 +136,11 @@ class Proxy:
                     status, payload, ctype = result
                     self.send_response(status)
                     self.send_header("Content-Type", ctype)
+                    request_id = getattr(
+                        self, "_rt_request_id", None
+                    )
+                    if request_id:
+                        self.send_header("x-request-id", request_id)
                     self.send_header(
                         "Content-Length", str(len(payload))
                     )
@@ -313,24 +318,90 @@ class Proxy:
         return best
 
     def _dispatch(self, handler) -> Tuple[int, bytes, str]:
-        from .router import DeploymentHandle
-
+        # The Handler instance persists across keep-alive requests:
+        # clear per-request state up front so no response (healthz
+        # included) can echo a PREVIOUS request's id.
+        handler._rt_request_id = None
         parsed = urlparse(handler.path)
         if parsed.path == "/-/healthz":
-            # Drain any body so the keep-alive stream stays in sync.
-            length = int(handler.headers.get("Content-Length") or 0)
-            if length:
-                handler.rfile.read(length)
-            return (
-                200,
-                json.dumps({
-                    "status": "ok",
-                    "connections": self._conn_count,
-                    "shed_requests": self.shed_requests,
-                    "shed_connections": self.shed_connections,
-                }).encode(),
-                "application/json",
+            return self._healthz(handler)
+        return self._dispatch_observed(handler, parsed)
+
+    def _healthz(self, handler) -> Tuple[int, bytes, str]:
+        # Drain any body so the keep-alive stream stays in sync.
+        length = int(handler.headers.get("Content-Length") or 0)
+        if length:
+            handler.rfile.read(length)
+        return (
+            200,
+            json.dumps({
+                "status": "ok",
+                "connections": self._conn_count,
+                "shed_requests": self.shed_requests,
+                "shed_connections": self.shed_connections,
+            }).encode(),
+            "application/json",
+        )
+
+    def _dispatch_observed(self, handler, parsed):
+        """Route + call the ingress, wrapped in the request-path
+        observability layer: a request id (client ``x-request-id``
+        honored, minted otherwise) that propagates router -> replica
+        -> multiplex and returns as a response header, an ingress
+        span, and per-deployment HTTP latency/status metrics."""
+        from ..util.tracing import span
+
+        from .observability import (
+            REQUEST_ID_HEADER,
+            new_request_id,
+            observe_http,
+        )
+
+        request_id = (
+            handler.headers.get(REQUEST_ID_HEADER) or new_request_id()
+        )
+        # Set for EVERY request, before routing: the handler instance
+        # persists across keep-alive requests, so a late assignment
+        # would echo request A's id on request B's 404/error response.
+        handler._rt_request_id = request_id
+        t0 = time.perf_counter()
+        # Filled by _route_request with the route that actually
+        # served the request — re-matching in the finally would both
+        # rescan the table and misattribute across a mid-request
+        # route-table refresh.
+        target = {"app": "", "deployment": ""}
+        status = 500
+        try:
+            with span(
+                "serve.http",
+                request_id=request_id,
+                path=parsed.path,
+            ):
+                result = self._route_request(
+                    handler, parsed, request_id, target
+                )
+            if result is None:
+                # Streamed: the 200 header is already on the wire.
+                status = 200
+                return None
+            status, payload, ctype = result
+            return status, payload, ctype
+        except Exception:
+            status = 500
+            raise
+        finally:
+            observe_http(
+                target["app"],
+                target["deployment"],
+                parsed.path,
+                status,
+                (time.perf_counter() - t0) * 1e3,
+                request_id,
             )
+
+    def _route_request(self, handler, parsed, request_id, target):
+        from .router import DeploymentHandle
+
         self._refresh_routes()
         match = self._match(parsed.path)
         if match is None:
@@ -343,6 +414,7 @@ class Proxy:
                 "application/json",
             )
         prefix, (app, ingress) = match
+        target["app"], target["deployment"] = app, ingress
         key = (app, ingress)
         if key not in self._handles:
             self._handles[key] = DeploymentHandle(app, ingress)
@@ -370,12 +442,15 @@ class Proxy:
             )
         if streaming:
             chunks = handle.options(
-                stream=True, multiplexed_model_id=model_id
+                stream=True,
+                multiplexed_model_id=model_id,
+                request_id=request_id,
             ).remote(request)
             self._stream_response(handler, chunks)
             return None
-        if model_id:
-            handle = handle.options(multiplexed_model_id=model_id)
+        handle = handle.options(
+            multiplexed_model_id=model_id, request_id=request_id
+        )
         value = handle.remote(request).result(timeout=60)
         if isinstance(value, bytes):
             return 200, value, "application/octet-stream"
@@ -393,6 +468,11 @@ class Proxy:
         responses for generator deployments — LLM token output)."""
         handler.send_response(200)
         handler.send_header("Content-Type", "text/plain; charset=utf-8")
+        # Streaming clients need the id MOST (runbook: grep a slow
+        # stream's id into the flight-recorder rings).
+        request_id = getattr(handler, "_rt_request_id", None)
+        if request_id:
+            handler.send_header("x-request-id", request_id)
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
         # Once the 200 header is out, NOTHING may escape this method:

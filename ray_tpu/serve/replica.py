@@ -78,7 +78,10 @@ class Replica:
             except Exception:
                 pass  # __slots__ classes: no router warmth hints
         self.replica_id = replica_id
+        self._app_name = app_name
+        self._deployment_name = deployment_name
         self._served = 0
+        self._executing = 0
         # Replicas run with max_concurrency > 1 (controller wires
         # max_ongoing_requests through actor concurrency), so replica
         # bookkeeping must be thread-safe; the USER instance is
@@ -87,47 +90,151 @@ class Replica:
         self._served_lock = threading.Lock()
         self._started = time.time()
 
-    def handle_request(
-        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
-    ):
-        from .multiplex import _set_request_model_id
+    def _begin_request(self, ctx: dict) -> None:
+        """Request-entry bookkeeping: queue wait (router send -> here)
+        and the executing gauge routers/`/api/serve` subtract from
+        in-flight to derive queue depth."""
+        from .observability import (
+            observe_queue_wait,
+            replica_executing,
+        )
 
         with self._served_lock:
             self._served += 1
+            self._executing += 1
+            executing = self._executing
+        sent = ctx.get("sent_ts")
+        if sent is not None:
+            observe_queue_wait(
+                self._app_name,
+                self._deployment_name,
+                (time.time() - float(sent)) * 1e3,
+            )
+        replica_executing(
+            self._app_name,
+            self._deployment_name,
+            self.replica_id,
+            executing,
+        )
+
+    def _end_request(self) -> None:
+        from .observability import replica_executing
+
+        with self._served_lock:
+            self._executing = max(0, self._executing - 1)
+            executing = self._executing
+        replica_executing(
+            self._app_name,
+            self._deployment_name,
+            self.replica_id,
+            executing,
+        )
+
+    def handle_request(
+        self,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        ctx: dict = None,
+    ):
+        from ..util.tracing import remote_parent, span
+
+        from .multiplex import _model_id_ctx, _set_request_model_id
+        from .observability import (
+            observe_handler,
+            request_context,
+            reset_request_context,
+        )
+
+        ctx = ctx or {}
+        self._begin_request(ctx)
         target = (
             self._instance
             if method == "__call__"
             else getattr(self._instance, method)
         )
         token = _set_request_model_id(model_id)
+        ctx_token = request_context(ctx)
+        request_id = str(ctx.get("request_id", ""))
+        t0 = time.perf_counter()
+        error = False
         try:
-            return target(*args, **kwargs)
+            with remote_parent(ctx.get("trace")):
+                with span(
+                    "serve.handle",
+                    request_id=request_id,
+                    deployment=(
+                        f"{self._app_name}/{self._deployment_name}"
+                    ),
+                ):
+                    return target(*args, **kwargs)
+        except BaseException:
+            error = True
+            raise
         finally:
-            from .multiplex import _model_id_ctx
-
+            observe_handler(
+                self._app_name,
+                self._deployment_name,
+                method,
+                (time.perf_counter() - t0) * 1e3,
+                error,
+                request_id=request_id,
+            )
+            self._end_request()
+            reset_request_context(ctx_token)
             _model_id_ctx.reset(token)
 
     def handle_request_streaming(
-        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+        self,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        ctx: dict = None,
     ):
         """Generator variant: the user method must yield chunks; each
         yield ships to the caller immediately over the runtime's
         streaming-generator transport (reference: replica.py
         handle_request_streaming + StreamingObjectRefGenerator).
-        Called with num_returns='streaming' by the router."""
+        Called with num_returns='streaming' by the router. Latency is
+        recorded over the WHOLE stream (first yield to exhaustion) —
+        the number a token-streaming client experiences."""
         from .multiplex import _model_id_ctx, _set_request_model_id
+        from .observability import (
+            observe_handler,
+            request_context,
+            reset_request_context,
+        )
 
-        with self._served_lock:
-            self._served += 1
+        ctx = ctx or {}
+        self._begin_request(ctx)
         target = (
             self._instance
             if method == "__call__"
             else getattr(self._instance, method)
         )
         token = _set_request_model_id(model_id)
+        ctx_token = request_context(ctx)
+        request_id = str(ctx.get("request_id", ""))
+        t0 = time.perf_counter()
+        error = False
         try:
             yield from target(*args, **kwargs)
+        except BaseException:
+            error = True
+            raise
         finally:
+            observe_handler(
+                self._app_name,
+                self._deployment_name,
+                method,
+                (time.perf_counter() - t0) * 1e3,
+                error,
+                request_id=request_id,
+            )
+            self._end_request()
+            reset_request_context(ctx_token)
             _model_id_ctx.reset(token)
 
     def node_id(self) -> str:
@@ -136,19 +243,54 @@ class Replica:
 
         return rt.get_runtime_context().get_node_id()
 
-    def handle_batch(self, method: str, batched_args: list):
+    def handle_batch(
+        self, method: str, batched_args: list, ctx: dict = None
+    ):
         """One call carrying many requests; the user method receives
-        the list (reference: serve/batching.py _BatchQueue)."""
+        the list (reference: serve/batching.py _BatchQueue). The whole
+        batch shares one request context; per-item latency is the
+        batch's (that is what each caller experienced)."""
+        from .observability import (
+            observe_handler,
+            request_context,
+            reset_request_context,
+        )
+
+        ctx = ctx or {}
+        self._begin_request(ctx)
         with self._served_lock:
-            self._served += len(batched_args)
+            self._served += len(batched_args) - 1
         target = getattr(self._instance, method)
-        return target([a[0] if len(a) == 1 else a for a in batched_args])
+        ctx_token = request_context(ctx)
+        t0 = time.perf_counter()
+        error = False
+        try:
+            return target(
+                [a[0] if len(a) == 1 else a for a in batched_args]
+            )
+        except BaseException:
+            error = True
+            raise
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            for _ in batched_args:
+                observe_handler(
+                    self._app_name,
+                    self._deployment_name,
+                    method,
+                    dur_ms,
+                    error,
+                    request_id=str(ctx.get("request_id", "")),
+                )
+            self._end_request()
+            reset_request_context(ctx_token)
 
     def stats(self) -> dict:
         return {
             "replica_id": self.replica_id,
             "pid": os.getpid(),
             "served": self._served,
+            "executing": self._executing,
             "uptime_s": time.time() - self._started,
         }
 

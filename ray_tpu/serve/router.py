@@ -167,7 +167,9 @@ class _BatchQueue:
 
         replica = self._handle._pick_replica()
         ref = replica["actor"].handle_batch.remote(
-            self._method, [e["args"] for e in batch]
+            self._method,
+            [e["args"] for e in batch],
+            self._handle._request_ctx(),
         )
 
         def deliver():
@@ -221,6 +223,7 @@ class DeploymentHandle:
         self._listener_box: Dict[str, Any] = {"thread": None}
         self._stream = False
         self._model_id = ""  # multiplexed model id for this clone
+        self._request_id = ""  # proxy-pinned request id, if any
 
     # -- routing -------------------------------------------------------
     def _refresh(self, force: bool = False) -> None:
@@ -420,6 +423,7 @@ class DeploymentHandle:
         self._share_state_with(clone)
         clone._method = name
         clone._model_id = self._model_id
+        clone._request_id = self._request_id
         return clone
 
     def options(
@@ -427,6 +431,7 @@ class DeploymentHandle:
         *,
         stream: bool = False,
         multiplexed_model_id: str = "",
+        request_id: str = "",
     ) -> "DeploymentHandle":
         """`stream=True` makes remote() return a
         DeploymentResponseGenerator whose chunks arrive as the replica
@@ -435,16 +440,39 @@ class DeploymentHandle:
         `multiplexed_model_id` tags requests with the model they need;
         the router prefers replicas already holding it and the replica
         exposes it via serve.get_multiplexed_model_id() (reference:
-        handle.options(multiplexed_model_id=...))."""
+        handle.options(multiplexed_model_id=...)).
+        `request_id` pins the next call's request id (the proxy
+        propagates the client's ``x-request-id`` this way); by default
+        each call mints its own."""
         clone = DeploymentHandle(
             self.app_name, self.deployment_name, self._method
         )
         self._share_state_with(clone)
         clone._stream = stream
         clone._model_id = multiplexed_model_id or self._model_id
+        clone._request_id = request_id or self._request_id
         return clone
 
+    def _request_ctx(self) -> dict:
+        """Request context shipped with the replica call: id (minted
+        here unless the proxy pinned one via options), deployment
+        identity, the send timestamp the replica turns into queue
+        wait, and the current span context so the replica's span
+        nests under the caller's trace."""
+        from ..util.tracing import inject_context
+
+        from .observability import new_request_context
+
+        return new_request_context(
+            self.app_name,
+            self.deployment_name,
+            request_id=self._request_id or None,
+            trace=inject_context(),
+        )
+
     def remote(self, *args, **kwargs):
+        from .observability import observe_routing
+
         self._refresh()
         with self._lock:
             batched = (
@@ -463,17 +491,24 @@ class DeploymentHandle:
                     "@serve.batch methods take positional args only"
                 )
             return batcher.submit(args)
+        t0 = time.perf_counter()
         replica = self._pick_replica()
+        observe_routing(
+            self.app_name,
+            self.deployment_name,
+            (time.perf_counter() - t0) * 1e3,
+        )
+        ctx = self._request_ctx()
         if self._stream:
             ref_gen = replica["actor"].handle_request_streaming.options(
                 num_returns="streaming"
-            ).remote(self._method, args, kwargs, self._model_id)
+            ).remote(self._method, args, kwargs, self._model_id, ctx)
             self._ongoing_sent(replica["id"])
             return DeploymentResponseGenerator(
                 ref_gen, self, replica["id"]
             )
         ref = replica["actor"].handle_request.remote(
-            self._method, args, kwargs, self._model_id
+            self._method, args, kwargs, self._model_id, ctx
         )
         self._ongoing_sent(replica["id"])
 

@@ -274,3 +274,25 @@ def metrics_summary() -> Dict[str, dict]:
     background flusher instead of failing the read."""
     flush_best_effort()
     return _worker().call("metrics_summary")["metrics"]
+
+
+def metrics_timeseries(
+    name: Optional[str] = None,
+    since: float = 0.0,
+    limit: int = 0,
+) -> List[dict]:
+    """Historical metric snapshots from the head's bounded
+    time-series ring, oldest first: ``[{"time", "metrics": {name:
+    {kind, total/value/count/sum/p50/p95/p99, by_tags, by_node}}}]``.
+    Counters rate-compute by differencing consecutive snapshots;
+    histogram snapshots carry reservoir percentiles so p99 trends
+    survive past the live window. `name` filters to one series,
+    `since` (unix seconds) to newer-than, `limit` keeps the newest N
+    snapshots."""
+    flush_best_effort()
+    kwargs: dict = {"since": float(since), "limit": int(limit)}
+    if name is not None:
+        kwargs["name"] = str(name)
+    return _worker().call("metrics_timeseries", **kwargs)[
+        "snapshots"
+    ]

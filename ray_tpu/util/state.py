@@ -36,7 +36,15 @@ def list_tasks(limit: int = 1000) -> List[dict]:
     latest = {}
     for event in events:
         latest[event["task_id"]] = event
-    return list(latest.values())[:limit]
+    # Newest first BEFORE truncating: dict order here is event-stream
+    # order, so a plain [:limit] under load dropped an arbitrary slice
+    # of tasks — the recent ones an operator is actually after.
+    rows = sorted(
+        latest.values(),
+        key=lambda e: float(e.get("time", 0.0)),
+        reverse=True,
+    )
+    return rows[:limit]
 
 
 def list_objects(limit: int = 1000) -> List[dict]:

@@ -220,6 +220,17 @@ def span(name: str, **attributes):
         })
 
 
+def inject_context() -> "dict | None":
+    """Wire-shippable form of the CURRENT span context — exactly the
+    dict `remote_parent()` adopts on the receiving side. None outside
+    any span, so callers can ship it unconditionally (serve's router
+    attaches it to every request context)."""
+    ctx = _current_span.get()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
 @contextmanager
 def remote_parent(trace_ctx: "dict | None"):
     """Adopt a caller-propagated span context (worker-side, around

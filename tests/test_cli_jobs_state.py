@@ -43,6 +43,27 @@ def test_state_api_lists(rt_session):
     assert state.summarize()
 
 
+def test_list_tasks_newest_first_under_limit(rt_session):
+    """`limit` keeps the NEWEST tasks: the old dict-order truncation
+    dropped an arbitrary slice of the table."""
+    rt = rt_session
+    from ray_tpu.util import state
+
+    @rt.remote
+    def tick(i):
+        return i
+
+    for i in range(6):
+        rt.get(tick.remote(i), timeout=20)
+    all_rows = state.list_tasks()
+    times = [float(r.get("time", 0.0)) for r in all_rows]
+    assert times == sorted(times, reverse=True)
+    newest_two = state.list_tasks(limit=2)
+    assert [r["task_id"] for r in newest_two] == [
+        r["task_id"] for r in all_rows[:2]
+    ]
+
+
 def test_job_submission_end_to_end(rt_session, tmp_path):
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
 
@@ -164,6 +185,82 @@ def test_cli_start_status_submit_stop(tmp_path):
             timeout=60,
         )
         assert head.wait(timeout=30) is not None
+    finally:
+        if head.poll() is None:
+            head.send_signal(signal.SIGKILL)
+
+
+def test_cli_state_ls_and_metrics(tmp_path):
+    """`ray_tpu state ls` + `ray_tpu metrics scrape/snapshot` against
+    a real head process: JSON contract, exit codes, Prometheus text."""
+    info = str(tmp_path / "cluster.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+    env["RT_metrics_timeseries_interval_s"] = "0.2"
+    head = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu",
+            "--cluster-info", info,
+            "start", "--head", "--num-cpus", "2", "--num-tpus", "0",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(info):
+            time.sleep(0.2)
+        assert os.path.exists(info), "head never wrote cluster info"
+
+        def run(*argv, timeout=60):
+            return subprocess.run(
+                [
+                    sys.executable, "-m", "ray_tpu",
+                    "--cluster-info", info, *argv,
+                ],
+                env=env, capture_output=True, text=True,
+                timeout=timeout,
+            )
+
+        out = run("state", "ls", "nodes", "--json")
+        assert out.returncode == 0, out.stdout + out.stderr
+        rows = json.loads(out.stdout)
+        assert len(rows) == 1 and rows[0]["is_head"]
+
+        # Human mode renders a header table; exit code stays 0 even
+        # when a kind is empty.
+        out = run("state", "ls", "pgs")
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "no pgs" in out.stdout
+
+        out = run("state", "ls", "tasks", "--json", "--limit", "5")
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert isinstance(json.loads(out.stdout), list)
+
+        # Unknown kinds fail with argparse's usage exit code (2),
+        # matching the lint/check CLI contract.
+        out = run("state", "ls", "bogus")
+        assert out.returncode == 2
+
+        out = run("metrics", "scrape")
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "# TYPE rt_nodes_alive gauge" in out.stdout
+        assert 'rt_nodes_alive{node="' in out.stdout
+
+        # Snapshot ring fills at 0.2 s/tick (env above); poll briefly.
+        deadline = time.time() + 30
+        snaps = []
+        while time.time() < deadline:
+            out = run("metrics", "snapshot", "--limit", "2")
+            assert out.returncode == 0, out.stdout + out.stderr
+            snaps = json.loads(out.stdout)
+            if len(snaps) >= 2:
+                break
+            time.sleep(0.3)
+        assert len(snaps) == 2
+        assert "metrics" in snaps[0] and "time" in snaps[0]
     finally:
         if head.poll() is None:
             head.send_signal(signal.SIGKILL)

@@ -4,7 +4,7 @@ classes that motivated it (ADVICE round 5: tcp_channel payload-dedup,
 autoscaler request packing, worker namespace pinning, sdk num_cpus
 truncation).
 
-Every rule RT001-RT008 has a positive fixture (must fire) and a
+Every rule RT001-RT009 has a positive fixture (must fire) and a
 negative fixture (must stay quiet); the repo lints itself clean — so
 a new framework idiom either passes the rules or carries an explicit
 `# rt: noqa[RTxxx]` reviewed in the diff.
@@ -216,6 +216,34 @@ CASES = [
         """,
         False,
     ),
+    (
+        "RT009",
+        "serve/metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        requests = Counter("serve.requests", tag_keys=("app",))
+        latency = Histogram(
+            "serve_latency_ms", tag_keys=("Deployment-Name",)
+        )
+        """,
+        True,
+    ),
+    (
+        "RT009",
+        "serve/metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        requests = Counter(
+            "serve_requests_total", tag_keys=("app", "deployment")
+        )
+        latency = Histogram(
+            "serve_latency_ms", tag_keys=("app", "deployment")
+        )
+        """,
+        False,
+    ),
 ]
 
 
@@ -332,7 +360,7 @@ def test_every_rule_has_id_title_and_doc():
     from ray_tpu.devtools.rules import ALL_RULES
 
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 9)]
+    assert ids == [f"RT00{i}" for i in range(1, 10)]
     for rule in ALL_RULES:
         assert rule.title
         assert rule.__doc__

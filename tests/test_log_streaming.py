@@ -150,6 +150,23 @@ def test_error_events_pushed_to_driver(cluster, capfd):
 
     d = Dies.remote()
     ref = d.boom.remote()  # fire and forget — never get()
+    # Condition first, output second: under full-suite load the
+    # death-detection chain (worker conn EOF -> actor DEAD -> event
+    # push) can outlast a flat output poll, so wait on the observable
+    # STATE with its own deadline — the error event is published
+    # before the DEAD transition lands in the actor table, so once
+    # the state is visible the line is already in flight.
+    from ray_tpu.util import state
+
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if any(
+            a.get("state") == "DEAD" for a in state.list_actors()
+        ):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("actor never reached DEAD state")
     _wait_for(capfd, "actor ")
 
 
