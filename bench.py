@@ -622,6 +622,17 @@ def run_micro_smoke() -> dict:
         results["put_get_10kb_per_s"] = _micro_case(
             lambda: rt.get(rt.put(small), timeout=30), 30, trials=2
         )
+        # Batched submit path (submit_tasks/execute_tasks coalescing):
+        # a 300-task flood outruns replies, so CI exercises multi-spec
+        # frames, per-spec fulfillment, and the in-flight window.
+        def _s2c_trial() -> float:
+            t0 = time.perf_counter()
+            rt.get([nop.remote() for _ in range(300)], timeout=120)
+            return 300 / (time.perf_counter() - t0)
+
+        results["task_submitted_to_completed_per_s"] = _micro_case_from(
+            _s2c_trial, trials=2, warmup=1
+        )
     finally:
         rt.shutdown()
     return results
@@ -692,13 +703,22 @@ def _micro_case(fn, n: int, scale: float = 1.0, digits: int = 1,
     to find its quiet core before the unstable flag lands. The
     reported trial count is the total actually run.
     """
+    return _micro_case_from(
+        lambda: _timeit(fn, n) * scale,
+        digits=digits, trials=trials, warmup=warmup,
+    )
+
+
+def _micro_case_from(trial_fn, digits: int = 1, trials: int = 0,
+                     warmup: int = -1) -> dict:
+    """The quiet-band trial policy over a trial function that returns
+    its own rate — for cases whose timed window must exclude a phase
+    (e.g. submit-rate cases that drain completions off the clock)."""
     import statistics
 
     for _ in range(MICRO_WARMUP if warmup < 0 else warmup):
-        fn()
-    rates = [
-        _timeit(fn, n) * scale for _ in range(trials or MICRO_TRIALS)
-    ]
+        trial_fn()
+    rates = [trial_fn() for _ in range(trials or MICRO_TRIALS)]
     extra = MICRO_EXTRA_TRIALS
 
     def spread(band: list) -> float:
@@ -706,7 +726,7 @@ def _micro_case(fn, n: int, scale: float = 1.0, digits: int = 1,
 
     band = _quiet_band(rates)
     while spread(band) > MICRO_MAX_SPREAD and extra > 0:
-        rates.append(_timeit(fn, n) * scale)
+        rates.append(trial_fn())
         extra -= 1
         band = _quiet_band(rates)
     q = statistics.quantiles(band, n=4) if len(band) >= 3 else band
@@ -783,6 +803,34 @@ def run_micro() -> dict:
         # a real cost profile the median then absorbs.
         results["task_throughput_per_s"] = _micro_case(
             lambda: _burst(nop.remote, 100), 5, scale=100
+        )
+
+        # 2b. batched submission: driver-side submit rate through the
+        # coalescing pipeline (completions drain OFF the clock — this
+        # is the `.remote()` ingest rate an RL/dataflow driver sees),
+        # and the end-to-end submitted-to-completed rate the same
+        # flood sustains (the scalebench tasks_100k number's micro
+        # twin). Both ride the batch path by construction: a 2000-task
+        # loop outruns replies, so specs coalesce into multi-spec
+        # execute_tasks frames.
+        def _submit_batch_trial() -> float:
+            t0 = time.perf_counter()
+            refs = [nop.remote() for _ in range(2000)]
+            dt = time.perf_counter() - t0
+            rt.get(refs, timeout=120)  # drain outside the timed window
+            return 2000 / dt
+
+        results["task_submit_batch_per_s"] = _micro_case_from(
+            _submit_batch_trial
+        )
+
+        def _s2c_trial() -> float:
+            t0 = time.perf_counter()
+            rt.get([nop.remote() for _ in range(2000)], timeout=120)
+            return 2000 / (time.perf_counter() - t0)
+
+        results["task_submitted_to_completed_per_s"] = _micro_case_from(
+            _s2c_trial
         )
 
         # 3. tasks with a small inline arg

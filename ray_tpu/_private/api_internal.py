@@ -87,10 +87,16 @@ def _export_cached(obj, cache_holder, attr: str, worker) -> str:
     return key
 
 
-def _strategy(options: Dict[str, Any]):
-    from ..util.scheduling_strategies import strategy_to_spec
+_strategy_to_spec = None
 
-    return strategy_to_spec(options.get("scheduling_strategy"))
+
+def _strategy(options: Dict[str, Any]):
+    global _strategy_to_spec
+    if _strategy_to_spec is None:  # one-time import, off the hot path
+        from ..util.scheduling_strategies import strategy_to_spec
+
+        _strategy_to_spec = strategy_to_spec
+    return _strategy_to_spec(options.get("scheduling_strategy"))
 
 
 def _resolve_placement(
@@ -126,6 +132,26 @@ def _resolve_placement(
 
 def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
     worker = _require_worker()
+    plan = rf._submit_plan
+    if (
+        plan is not None
+        and plan[0] == worker.generation
+        and worker.current_pg_context() is None
+    ):
+        # Hot path: every option was resolved ONCE for this (function,
+        # session) pair — a 20k/s submit loop re-derives nothing. Only
+        # an inherited placement-group capture context (dynamic,
+        # per-executing-task) forces the full resolution below.
+        _, func_key, name, num_returns, resources, max_retries = plan
+        refs = worker.submit_task(
+            func_key,
+            _flatten_args(args, kwargs),
+            name=name,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=max_retries,
+        )
+        return refs[0] if num_returns == 1 else refs
     opts = rf.task_options
     func_key = _export_cached(rf.underlying, rf, "_exported_key", worker)
     num_returns = opts.get("num_returns", 1)
@@ -138,18 +164,35 @@ def submit_function(rf: RemoteFunction, args: tuple, kwargs: dict):
             opts, resources, worker
         )
     _validate_num_returns(num_returns)
+    name = opts.get("name") or rf.underlying.__name__
+    max_retries = opts.get("max_retries", worker.config.task_max_retries)
+    runtime_env = prepare_runtime_env(opts.get("runtime_env"), worker)
+    if (
+        not strategy
+        and pg_context is None
+        and runtime_env is None
+        and not opts.get("_skip_pg_rewrite")
+        and isinstance(num_returns, int)
+    ):
+        # Static options: memoize the resolved plan for this session
+        # (generation-keyed like _exported_key, so a dead worker's
+        # plan never outlives shutdown()+init()).
+        rf._submit_plan = (
+            worker.generation, func_key, name, num_returns,
+            resources, max_retries,
+        )
     refs = worker.submit_task(
         func_key,
         _flatten_args(args, kwargs),
         # name= is a display-name override (reference: task options
         # name); the option-key universe lives in _private/options.py.
-        name=opts.get("name") or rf.underlying.__name__,
+        name=name,
         num_returns=num_returns,
         resources=resources,
-        max_retries=opts.get("max_retries", worker.config.task_max_retries),
+        max_retries=max_retries,
         scheduling_strategy=strategy,
         pg_context=pg_context,
-        runtime_env=prepare_runtime_env(opts.get("runtime_env"), worker),
+        runtime_env=runtime_env,
     )
     return _generator_or_refs(refs, num_returns, worker)
 

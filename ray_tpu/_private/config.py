@@ -93,6 +93,26 @@ class Config:
     #: admission is the real concurrency gate, so this must stay above
     #: any concurrency the declared resources can admit.
     direct_call_max_leases: int = 64
+
+    # ---- batched task submission (reference: the CoreWorker submit
+    # path amortizes the raylet round trip; here one wire round trip
+    # covers a whole spec batch) ----
+    #: Kill switch: False reverts every submit path to per-task RPCs
+    #: (`submit_task` / `execute_task`), the pre-batching wire shape.
+    task_submit_batching: bool = True
+    #: Max specs coalesced into one `submit_tasks` / `execute_tasks`
+    #: frame. Batches form only under backlog — an idle pipeline sends
+    #: a single-spec frame immediately, so latency never waits on a
+    #: flush timer (flush interval is effectively 0).
+    submit_batch_max_specs: int = 256
+    #: Bounded in-flight window: max specs outstanding per leased
+    #: worker connection (direct path) before further submissions
+    #: queue driver-side — the backpressure that keeps a 1M-task
+    #: flood out of the wire while the queue absorbs it.
+    submit_inflight_specs: int = 512
+    #: In-flight `submit_tasks` batches per driver on the daemon path
+    #: before the submit queue holds further frames back.
+    submit_inflight_batches: int = 4
     #: Cap on the TASK worker pool per node (0 = 4 * num_cpus).
     #: Actor-dedicated workers are exempt — one per live actor,
     #: admission-controlled by the actor's resource request — so total

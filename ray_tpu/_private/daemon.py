@@ -457,9 +457,11 @@ class NodeDaemon:
             "kv_del",
             "kv_keys",
             "submit_task",
+            "submit_tasks",
             "submit_actor_task",
             "create_actor",
             "get_object",
+            "get_objects",
             "wait_objects",
             "put_inline",
             "object_sealed",
@@ -1351,6 +1353,38 @@ class NodeDaemon:
             return DEFERRED
         return reply
 
+    def _h_get_objects(self, conn, msg):
+        """Batched NON-BLOCKING get: one round trip resolves every oid
+        the daemon can answer right now (the worker's many-arg fetch
+        path — per-arg blocking gets cost one RTT each). Unready or
+        remote oids come back as pending markers (a pull is kicked for
+        sealed-elsewhere entries); the caller falls back to blocking
+        get_object for those, which waits exactly like before."""
+        out = []
+        pulls = []
+        oids = msg["oids"]
+        # Chunked lock scope: a 10k-oid request must not pin the hot
+        # lock for the whole scan.
+        for start in range(0, len(oids), 512):
+            with self._lock:
+                for blob in oids[start:start + 512]:
+                    oid = ObjectID(blob)
+                    entry = self.objects.get(oid)
+                    if entry is None or entry.state == PENDING:
+                        out.append({"pending": True})
+                    elif entry.state == ERRORED:
+                        out.append({"error": entry.error})
+                    elif entry.inline is not None:
+                        out.append({"inline": entry.inline})
+                    elif entry.in_shm:
+                        out.append({"shm_size": entry.size})
+                    else:
+                        pulls.append(oid)
+                        out.append({"pending": True})
+        for oid in pulls:
+            self._ensure_local(oid)
+        return {"results": out}
+
     def _meta_reply(self, oid: ObjectID) -> dict:
         """Metadata view served to node daemons (head only)."""
         with self._lock:
@@ -1400,12 +1434,21 @@ class NodeDaemon:
                 return self._pull_from_spill(oid, offset, length)
             try:
                 total = len(pin.view)
-                chunk = bytes(
-                    pin.view[offset : min(offset + length, total)]
+                view = pin.view[offset : min(offset + length, total)]
+                # Zero-copy send: reply INSIDE the pin scope so the
+                # chunk scatter-gathers straight from the arena onto
+                # the socket (pickle-5 out-of-band buffer) — the
+                # bytes() staging copy this replaces was one full
+                # memcpy per transferred chunk. sendmsg has fully
+                # handed the bytes to the kernel when reply returns,
+                # so releasing the pin afterwards is safe.
+                conn.reply(
+                    msg["_mid"],
+                    {"data": _oob_chunk(view), "total_size": total},
                 )
+                return DEFERRED
             finally:
                 pin.release()
-            return {"data": _oob_chunk(chunk), "total_size": total}
         view = self.store.get(oid, timeout=0.1)
         if view is None and size is not None:
             # Segment was created directly by a local worker process;
@@ -1417,7 +1460,11 @@ class NodeDaemon:
         if view is None:
             return self._pull_from_spill(oid, offset, length)
         total = len(view)
-        chunk = bytes(view[offset : min(offset + length, total)])
+        # Zero-copy: the numpy wrapper keeps the segment view (and its
+        # pages) alive until the reply frame has been sent; per-object
+        # segments are kernel-refcounted, so a concurrent delete only
+        # unlinks the name.
+        chunk = view[offset : min(offset + length, total)]
         return {"data": _oob_chunk(chunk), "total_size": total}
 
     def _pull_from_spill(self, oid: ObjectID, offset: int, length: int):
@@ -2412,10 +2459,12 @@ class NodeDaemon:
             )
         return views
 
-    def _submit_cluster(self, spec: dict) -> None:
+    def _submit_cluster(self, spec: dict, schedule: bool = True) -> None:
         """Place a task spec on a node (head only). Infeasible specs
         wait for the cluster to change (reference: tasks queue until
-        resources exist)."""
+        resources exist). `schedule=False` defers the local dispatch
+        pass to the caller — batch ingestion runs ONE pass per batch
+        instead of one per spec."""
         task_id = TaskID(spec["task_id"])
         request = ResourceSet(spec.get("resources", {}))
         target = self._policy.pick(
@@ -2441,7 +2490,8 @@ class NodeDaemon:
                     aid = ActorID(spec["actor_id"])
                     self.actor_hosts.setdefault(aid, ActorHost(spec))
             self.scheduler.enqueue(task_id, request, spec)
-            self._schedule()
+            if schedule:
+                self._schedule()
             return
         client = self._node_client(target)
         if client is None:
@@ -2489,6 +2539,60 @@ class NodeDaemon:
         self._pin_args(spec)
         self._submit_cluster(spec)
         return {}
+
+    def _h_submit_tasks(self, conn, msg):
+        """Batched task ingestion: one wire round trip covers a whole
+        flat-codec spec batch. Ingestion is IDEMPOTENT by task_id —
+        re-sending a batch whose first attempt was lost in transport
+        re-ingests only the specs the head never saw, which is what
+        makes driver-side batch retry exactly-once. Per-spec decode
+        failures ride back as {index: error} so one malformed spec
+        fails alone. Dispatch interleaves with ingestion: each batch
+        schedules before the connection's ordered drain picks up the
+        next frame, so early tasks complete while later batches are
+        still arriving."""
+        from .wire import SpecCodecError, decode_spec, split_spec_batch
+
+        if not self.is_head:
+            return self.head.call(
+                "submit_tasks", specs=msg["specs"], count=msg["count"]
+            )
+        blobs = split_spec_batch(msg["specs"])
+        # Decode OUTSIDE the hot lock: a 256-spec frame (with embedded
+        # pickles for cold fields) is milliseconds of pure decode, and
+        # heartbeats/dispatch must not stall behind it.
+        decoded = []
+        errors = {}
+        for i, blob in enumerate(blobs):
+            try:
+                spec = decode_spec(blob)
+                decoded.append((TaskID(spec["task_id"]), spec))
+            except (SpecCodecError, ValueError) as e:
+                errors[i] = repr(e)
+        accepted = []
+        with self._lock:
+            for task_id, spec in decoded:
+                if task_id in self.tasks:
+                    continue  # retried batch: already ingested
+                self.tasks[task_id] = TaskEntry(
+                    spec=spec, retries_left=spec.get("max_retries", 0)
+                )
+                for ret in spec["returns"]:
+                    self._ensure_entry(ObjectID(ret))
+                accepted.append(spec)
+        for spec in accepted:
+            self._pin_args(spec)
+            self._submit_cluster(spec, schedule=False)
+        if accepted:
+            # One dispatch pass per batch, not per spec: enqueue is
+            # O(1), and the pass runs while the NEXT batch is still in
+            # the socket — submit-flood ingestion and dispatch
+            # interleave at batch granularity.
+            self._schedule()
+        reply = {"accepted": len(accepted)}
+        if errors:
+            reply["errors"] = errors
+        return reply
 
     def _h_schedule_task(self, conn, msg):
         """Head forwarded a task to run on this node."""
@@ -4683,7 +4787,11 @@ class NodeDaemon:
     def _h_task_event(self, conn, msg):
         """Workers report state events for direct-transport tasks
         (the daemon never sees those specs; reference: workers batch
-        task events to the GCS task manager the same way)."""
+        task events to the GCS task manager the same way). Completion
+        counts may ride the same frame (the worker's flush sends ONE
+        notify per drain, not two)."""
+        if msg.get("finished") or msg.get("failed"):
+            self._h_task_counts(conn, msg)
         if not self.config.task_events_enabled:
             return {}
         if not self.is_head:

@@ -54,11 +54,14 @@ from typing import Dict, List, Optional, Tuple
 from .ids import ObjectID
 from .rpc import ConnectionLost, RpcClient, RpcError
 from .task_spec import make_error_payload
+from .wire import decode_spec, encode_spec, encode_spec_batch
 
-#: In-flight request cap per leased connection. 1 = every task lands
-#: on an idle worker (no head-of-line blocking behind a slow task);
-#: queued backlog is re-dispatched from reply callbacks, which already
-#: pipelines the socket turnaround.
+#: In-flight request cap per leased connection when batching is OFF
+#: (config task_submit_batching=False). 1 = every task lands on an
+#: idle worker (no head-of-line blocking behind a slow task); queued
+#: backlog is re-dispatched from reply callbacks, which already
+#: pipelines the socket turnaround. With batching ON the cap comes
+#: from config submit_inflight_specs instead.
 _PIPELINE_CAP = 1
 
 
@@ -160,7 +163,7 @@ class ResultFuture:
 class _Lease:
     __slots__ = (
         "lease_id", "worker_id", "address", "client", "in_flight",
-        "last_used", "dead",
+        "last_used", "dead", "proven", "blocked",
     )
 
     def __init__(self, lease_id, worker_id, address):
@@ -171,19 +174,79 @@ class _Lease:
         self.in_flight = 0
         self.last_used = time.monotonic()
         self.dead = False
+        #: A lease takes multi-spec frames only after completing at
+        #: least one spec. Until then it gets singles, so a burst of
+        #: BLOCKING tasks (gang rendezvous, collectives) spreads
+        #: across the growing pool exactly like the per-task wire
+        #: shape did — stacking gang members behind each other on one
+        #: worker deadlocks the gang.
+        self.proven = False
+        #: The worker reclaimed queued specs because its running spec
+        #: wouldn't finish: stop refilling until a real outcome shows
+        #: the loop is moving again.
+        self.blocked = False
+
+
+class _Pending:
+    """One queued submission in batch mode: the flat-codec blob plus
+    the driver-side bookkeeping batching must keep (returns for
+    fulfillment, retry budget). The spec DICT is dropped at submit —
+    at the 1M-queued-task scale the ~150-byte blob replaces a
+    kilobyte-class dict in driver RSS; the rare daemon-fallback path
+    recovers the dict via decode_spec."""
+
+    __slots__ = ("blob", "returns", "retries_left", "solo")
+
+    def __init__(
+        self,
+        blob: bytes,
+        returns: list,
+        retries_left: int,
+        solo: bool = False,
+    ):
+        self.blob = blob
+        self.returns = returns
+        self.retries_left = retries_left
+        #: Must ride a SIZE-1 frame: one of this spec's args is a
+        #: still-pending direct result, and the executing worker will
+        #: block on it until the producer's reply lands driver-side
+        #: and is published. Inside a multi-spec frame that wait can
+        #: deadlock — the producer's own reply may be the tail of a
+        #: batch whose earlier spec is doing the waiting.
+        self.solo = solo
 
 
 class _KeyState:
     """Per-scheduling-key backlog + lease pool (lock: ks.lock)."""
 
-    __slots__ = ("queue", "lock", "leases", "requests_in_flight", "closed")
+    __slots__ = (
+        "queue", "lock", "leases", "requests_in_flight", "closed", "hot",
+    )
 
     def __init__(self):
-        self.queue: List[dict] = []
+        # Deque, not list: the flood regime (1M queued specs) pops
+        # from the head at batch rate — list.pop(0) is O(queue) and
+        # turned the drain quadratic exactly when the queue was
+        # deepest.
+        from collections import deque
+
+        self.queue = deque()
         self.lock = threading.Lock()
         self.leases: Dict[str, _Lease] = {}
         self.requests_in_flight = 0
         self.closed = False
+        #: Submission-regime hysteresis (batch mode). Cold: a lone
+        #: submit ships immediately to an idle lease (latency mode).
+        #: Hot (monotonic deadline): a multi-spec drain proved a
+        #: submit loop is outpacing replies — submissions only queue,
+        #: and reply-driven drains coalesce them into large frames.
+        #: Without this, fast workers make a lease idle between two
+        #: `.remote()` calls and every task ships as its own frame:
+        #: one sendmsg wakeup per task was the measured flood
+        #: ceiling. Time-decayed (not a flag) so one drain that
+        #: briefly empties the queue mid-flood doesn't flap the
+        #: regime back to per-task frames.
+        self.hot = 0.0
 
 
 def scheduling_key(spec: dict) -> tuple:
@@ -209,6 +272,19 @@ class DirectTaskManager:
         self._shutdown = False
         cfg = core.config
         self._idle_timeout = cfg.worker_lease_idle_timeout_s
+        # Batched + pipelined submission (ROADMAP item 3): coalesce
+        # queued specs into execute_tasks frames (flat-codec blobs,
+        # wire.encode_spec_batch) under a bounded in-flight window.
+        # Batches form only from backlog — an idle lease still gets a
+        # single-spec frame immediately, so latency never waits on a
+        # flush timer.
+        self._batching = cfg.task_submit_batching
+        self._batch_max = max(1, cfg.submit_batch_max_specs)
+        self._window = (
+            max(1, cfg.submit_inflight_specs)
+            if self._batching
+            else _PIPELINE_CAP
+        )
         # The real concurrency gate is the daemon scheduler's resource
         # admission (lease grants reserve the task's resources); this
         # is only an anti-runaway cap. It must NOT be lower than the
@@ -248,10 +324,47 @@ class DirectTaskManager:
                 self._futures[ret] = (fut, i)
         return fut
 
-    def submit(self, spec: dict) -> None:
-        spec["_retries_left"] = spec.get("max_retries", 0)
+    def submit(self, spec: dict, solo: bool = False) -> None:
         key = scheduling_key(spec)
         ks = self._key_state(key)
+        if self._batching:
+            # FIFO through the queue, always: a new spec never jumps
+            # ahead of queued backlog onto a freshly-idle lease. An
+            # idle lease takes a batch NOW (a lone spec ships as a
+            # single-spec frame — no flush-timer latency); with every
+            # lease busy the spec just queues and reply-driven drains
+            # coalesce it into a large frame. That hysteresis is what
+            # turns a tight `.remote()` loop into hundreds-of-specs
+            # frames instead of one frame per task.
+            entry = _Pending(
+                encode_spec(spec),
+                spec["returns"],
+                spec.get("max_retries", 0),
+                solo=solo,
+            )
+            batch = None
+            lease = None
+            want_more = False
+            with ks.lock:
+                ks.queue.append(entry)
+                if ks.hot < time.monotonic():
+                    lease = self._pick_lease(ks)
+                    if lease is not None:
+                        batch = self._take_batch_locked(ks, lease)
+                # Grow the pool ONE request at a time while backlog
+                # remains (see the legacy branch's rationale below).
+                if ks.queue and (
+                    ks.requests_in_flight == 0
+                    and len(ks.leases) < self._max_leases
+                ):
+                    want_more = True
+                    ks.requests_in_flight += 1
+            if batch:
+                self._send_batch(key, ks, lease, batch)
+            if want_more:
+                self._enqueue_lease_request(key, ks)
+            return
+        spec["_retries_left"] = spec.get("max_retries", 0)
         lease = None
         want_more = False
         with ks.lock:
@@ -282,15 +395,15 @@ class DirectTaskManager:
 
     @staticmethod
     def _pick_lease(ks: _KeyState) -> Optional[_Lease]:
-        """Least-loaded live lease with pipeline room (caller holds
-        ks.lock)."""
-        best = None
+        """An IDLE live lease (caller holds ks.lock). Only idle leases
+        take a submission inline — busy leases coalesce backlog from
+        ks.queue into batch frames as their replies drain, which is
+        what turns a tight `.remote()` loop into a few large frames
+        instead of one frame per task."""
         for lease in ks.leases.values():
-            if lease.dead or lease.in_flight >= _PIPELINE_CAP:
-                continue
-            if best is None or lease.in_flight < best.in_flight:
-                best = lease
-        return best
+            if not lease.dead and lease.in_flight == 0:
+                return lease
+        return None
 
     def _key_state(self, key) -> _KeyState:
         with self._lock:
@@ -307,9 +420,205 @@ class DirectTaskManager:
             spec=spec,
         )
 
+    def _send_batch(
+        self, key, ks: _KeyState, lease: _Lease, batch: List[_Pending]
+    ) -> None:
+        """One execute_tasks frame for N specs: blobs were encoded at
+        submit, so the frame build is a length-prefixed join plus one
+        outer pickle of a single bytes object. Outcomes stream back
+        as partial reply frames (`seen` tracks fulfilled indexes
+        across them) — a quick spec is never held hostage by a slow
+        one later in the same frame."""
+        seen: set = set()
+        lease.client.call_async(
+            "execute_tasks",
+            lambda reply: self._on_batch_reply(
+                key, ks, lease, batch, seen, reply
+            ),
+            # Hub-thread delivery: accounting + window refill + fulfill
+            # run with zero thread handoffs; refill sends are bounded
+            # by the in-flight window, so the socket buffer the hub
+            # writes into is one the worker is actively draining.
+            inline=True,
+            specs=encode_spec_batch(e.blob for e in batch),
+            count=len(batch),
+        )
+
+    def _take_batch_locked(
+        self, ks: _KeyState, lease: _Lease
+    ) -> Optional[List[_Pending]]:
+        """Reserve the next batch of queued specs for `lease` (caller
+        holds ks.lock): bounded by the in-flight window (backpressure)
+        and the per-frame batch cap. Maintains the hot/cold regime:
+        a multi-spec take proves a submit loop is outpacing replies
+        (go hot); an empty queue proves it ended (go cold)."""
+        if ks.closed or lease.dead or lease.blocked or not ks.queue:
+            return None
+        if not lease.proven and lease.in_flight > 0:
+            return None  # one spec at a time until the first completes
+        room = self._window - lease.in_flight
+        if room <= 0:
+            return None
+        n = min(room, self._batch_max, len(ks.queue))
+        if ks.queue[0].solo:
+            # Pending-direct-dep spec: its own frame, AND never
+            # stacked behind anything on this worker — solo specs
+            # block in-worker on results other specs must be free to
+            # produce, so each occupies a lease exclusively (the
+            # per-task wire shape's concurrency contract).
+            if lease.in_flight > 0:
+                return None
+            n = 1
+        elif not lease.proven:
+            # Unproven lease (nothing completed yet — could be about
+            # to run a blocking gang member): singles until the first
+            # completion (see _Lease.proven).
+            n = 1
+        else:
+            # Stop a multi-spec frame BEFORE the first solo entry.
+            for i in range(1, n):
+                if ks.queue[i].solo:
+                    n = i
+                    break
+        pop = ks.queue.popleft
+        now = time.monotonic()
+        batch = [pop() for _ in range(n)]
+        lease.in_flight += n
+        lease.last_used = now
+        if n > 1:
+            ks.hot = now + 0.005  # stay in coalescing mode ~5ms
+        return batch
+
+    def _drain_lease(self, key, ks: _KeyState, lease: _Lease) -> None:
+        """Refill `lease`'s in-flight window from the backlog (batch
+        mode). Sends run outside ks.lock."""
+        while True:
+            with ks.lock:
+                batch = self._take_batch_locked(ks, lease)
+            if not batch:
+                return
+            self._send_batch(key, ks, lease, batch)
+
+    def _on_batch_reply(
+        self, key, ks, lease, batch: List[_Pending], seen: set,
+        reply: dict,
+    ) -> None:
+        """Runs per outcome frame (partial or final) on the lease
+        connection's reader thread. Per-spec error isolation: one
+        failed spec fails only its own returns — the batch envelope
+        succeeds or fails as transport, never as semantics."""
+        err = reply.get("_error")
+        if err is not None:
+            # Only the specs whose outcomes never arrived are
+            # affected — earlier partial frames already fulfilled
+            # (and window-released) theirs.
+            unseen = [
+                entry for i, entry in enumerate(batch) if i not in seen
+            ]
+            if err == "__chaos_injected_failure__":
+                # Injected drop (RT_testing_rpc_failure): nothing hit
+                # the wire and the worker is healthy — requeue at the
+                # front in original order and resend on the SAME
+                # lease. No retry budget is spent and nothing can
+                # have executed: exactly-once by construction.
+                with ks.lock:
+                    lease.in_flight -= len(unseen)
+                    for entry in reversed(unseen):
+                        ks.queue.appendleft(entry)
+                self._drain_lease(key, ks, lease)
+                return
+            self._on_lease_failure_batch(key, ks, lease, unseen, err)
+            return
+        parts = reply.get("parts") or []
+        final = not reply.get("_part")
+        # A worker that reclaimed unstarted specs from behind a
+        # long-running one returns them as requeue outcomes: they go
+        # back to the FRONT of the queue for other leases (the pool
+        # grows if none are free) — never re-executed, never failed.
+        real_parts = []
+        requeued: List[_Pending] = []
+        for index, outcome in parts:
+            seen.add(index)
+            if outcome.get("requeue"):
+                requeued.append(batch[index])
+            else:
+                real_parts.append((index, outcome))
+        # Lease accounting (and window refill) BEFORE fulfilling: a
+        # fulfilled waiter may submit its next task immediately and
+        # must see this lease's window open.
+        missing: List[int] = []
+        want_more = False
+        with ks.lock:
+            lease.in_flight -= len(parts)
+            if final:
+                missing = [
+                    i for i in range(len(batch)) if i not in seen
+                ]
+                lease.in_flight -= len(missing)
+            lease.last_used = time.monotonic()
+            if real_parts:
+                lease.proven = True
+                lease.blocked = False
+            elif requeued:
+                lease.blocked = True
+            # APPEND, not appendleft: requeue frames arrive oldest-
+            # first (the worker reclaims its queue in FIFO order), so
+            # appending preserves the original submission order
+            # across frames — prepending inverted it, putting
+            # consumers ahead of the producers they block on, which
+            # deadlocked dependency chains.
+            ks.queue.extend(requeued)
+            if requeued and ks.queue and (
+                ks.requests_in_flight == 0
+                and len(ks.leases) < self._max_leases
+            ):
+                want_more = True
+                ks.requests_in_flight += 1
+        # One manager-lock acquisition for the whole frame's future
+        # lookups (not one per spec), then fulfill outside the lock.
+        fulfills = []
+        with self._lock:
+            futures = self._futures
+
+            def find(entry):
+                for ret in entry.returns:
+                    found = futures.get(ret)
+                    if found is not None:
+                        return found[0]
+                return None  # every handle dropped pre-completion
+
+            for index, outcome in real_parts:
+                fut = find(batch[index])
+                if fut is not None:
+                    fulfills.append((fut, outcome))
+            for index in missing:
+                # A well-formed final frame accounts for every spec;
+                # a gap means the executor dropped one — fail it
+                # individually.
+                seen.add(index)
+                fut = find(batch[index])
+                if fut is not None:
+                    fulfills.append((fut, {
+                        "error": make_error_payload(
+                            "WorkerCrashedError",
+                            "batch reply missing this spec's outcome",
+                        )
+                    }))
+        if want_more:
+            self._enqueue_lease_request(key, ks)
+        self._drain_lease(key, ks, lease)
+        for fut, outcome in fulfills:
+            fut.fulfill(outcome.get("results"), outcome.get("error"))
+
     def _on_reply(self, key, ks, lease, spec, reply: dict) -> None:
-        """Runs on the lease connection's reader thread."""
+        """Runs on the lease connection's reader thread (per-task
+        wire shape: task_submit_batching=False)."""
         if reply.get("_error") is not None:
+            if reply["_error"] == "__chaos_injected_failure__":
+                # Injected drop: resend on the same (healthy) lease —
+                # see _on_batch_reply. Nothing was sent or executed.
+                self._send(key, ks, lease, spec)
+                return
             self._on_lease_failure(key, ks, lease, spec, reply["_error"])
             return
         # Lease accounting BEFORE fulfilling: the fulfilled waiter may
@@ -318,7 +627,7 @@ class DirectTaskManager:
         next_spec = None
         with ks.lock:
             if ks.queue and not ks.closed and not lease.dead:
-                next_spec = ks.queue.pop(0)
+                next_spec = ks.queue.popleft()
                 lease.last_used = time.monotonic()
             else:
                 lease.in_flight -= 1
@@ -373,6 +682,7 @@ class DirectTaskManager:
             for key, ks in keys:
                 to_release = []
                 starved = False
+                drain = None
                 with ks.lock:
                     for lid, lease in list(ks.leases.items()):
                         if (
@@ -381,8 +691,15 @@ class DirectTaskManager:
                         ):
                             del ks.leases[lid]
                             to_release.append(lease)
+                    if self._batching and ks.queue:
+                        # Backlog + an idle survivor (e.g. after a
+                        # batch requeue landed while every reply was
+                        # already drained): refill its window rather
+                        # than leasing another worker.
+                        drain = self._pick_lease(ks)
                     starved = (
                         bool(ks.queue)
+                        and drain is None
                         and ks.requests_in_flight == 0
                         and self._pick_lease(ks) is None
                         and len(ks.leases) < self._max_leases
@@ -391,6 +708,8 @@ class DirectTaskManager:
                         ks.requests_in_flight += 1
                 for lease in to_release:
                     self._drop_lease(lease, release=True)
+                if drain is not None:
+                    self._drain_lease(key, ks, drain)
                 if starved:
                     self._request_lease(key, ks)
 
@@ -428,11 +747,12 @@ class DirectTaskManager:
                 # is serving this key, push queued work back to the
                 # daemon path so nothing strands.
                 if not ks.leases and not ks.requests_in_flight:
-                    stranded, ks.queue = ks.queue, []
+                    stranded = list(ks.queue)
+                    ks.queue.clear()
                 else:
                     stranded = []
-            for spec in stranded:
-                self._fallback_to_daemon(spec)
+            for entry in stranded:
+                self._fallback_to_daemon(entry)
             return
         sends = []
         chain = False
@@ -443,9 +763,10 @@ class DirectTaskManager:
             else:
                 leave = False
                 ks.leases[granted.lease_id] = granted
-                while ks.queue and granted.in_flight < _PIPELINE_CAP:
-                    sends.append(ks.queue.pop(0))
-                    granted.in_flight += 1
+                if not self._batching:
+                    while ks.queue and granted.in_flight < _PIPELINE_CAP:
+                        sends.append(ks.queue.popleft())
+                        granted.in_flight += 1
                 granted.last_used = time.monotonic()
                 # Backlog remains: chain the next growth request.
                 if (
@@ -458,8 +779,11 @@ class DirectTaskManager:
         if leave:
             self._drop_lease(granted, release=True)
             return
-        for spec in sends:
-            self._send(key, ks, granted, spec)
+        if self._batching:
+            self._drain_lease(key, ks, granted)
+        else:
+            for spec in sends:
+                self._send(key, ks, granted, spec)
         if chain:
             self._request_lease(key, ks)
 
@@ -489,7 +813,7 @@ class DirectTaskManager:
             requeued = False
             with ks.lock:
                 if not ks.closed:
-                    ks.queue.insert(0, spec)
+                    ks.queue.appendleft(spec)
                     if ks.requests_in_flight == 0:
                         ks.requests_in_flight += 1
                         requeued = True
@@ -502,9 +826,59 @@ class DirectTaskManager:
             )
             self._fulfill(spec, {"error": payload})
 
-    def _fallback_to_daemon(self, spec: dict) -> None:
+    def _on_lease_failure_batch(
+        self, key, ks, lease, batch: List[_Pending], err
+    ) -> None:
+        """A whole batch frame failed in transport (lease connection
+        broke, chaos injection). The failure maps back to the
+        INDIVIDUAL specs: each retries on another lease under its own
+        budget (in original submission order) or fails its own
+        returns — exactly the per-spec semantics of N separate
+        submissions. A chaos-injected drop happens before any bytes
+        hit the wire, so the retried batch executes exactly once."""
+        with ks.lock:
+            ks.leases.pop(lease.lease_id, None)
+        self._drop_lease(lease, release=False)  # daemon saw the death
+        retry: List[_Pending] = []
+        failed: List[_Pending] = []
+        for entry in batch:
+            if entry.retries_left > 0:
+                entry.retries_left -= 1
+                retry.append(entry)
+            else:
+                failed.append(entry)
+        requeued = False
+        if retry:
+            with ks.lock:
+                if not ks.closed:
+                    # Front of the queue in original order: retried
+                    # specs keep their place ahead of younger work.
+                    for entry in reversed(retry):
+                        ks.queue.appendleft(entry)
+                    if ks.requests_in_flight == 0:
+                        ks.requests_in_flight += 1
+                        requeued = True
+                else:
+                    failed.extend(retry)
+        if requeued:
+            self._enqueue_lease_request(key, ks)
+        for entry in failed:
+            self._fulfill_returns(entry.returns, {
+                "error": make_error_payload(
+                    "WorkerCrashedError",
+                    f"leased worker died while running task ({err})",
+                )
+            })
+
+    def _fallback_to_daemon(self, entry) -> None:
         """Strip direct bookkeeping and hand the spec to the daemon
-        path; mark its futures so get()/wait() consult the daemon."""
+        path; mark its futures so get()/wait() consult the daemon.
+        Batch-mode entries recover their spec dict from the blob —
+        this path runs only when the lease plane is gone."""
+        if isinstance(entry, _Pending):
+            spec = decode_spec(entry.blob)
+        else:
+            spec = entry
         spec.pop("_retries_left", None)
         with self._lock:
             futures = {
@@ -533,11 +907,14 @@ class DirectTaskManager:
 
     # -- results -------------------------------------------------------
     def _fulfill(self, spec: dict, reply: dict) -> None:
+        self._fulfill_returns(spec["returns"], reply)
+
+    def _fulfill_returns(self, returns, reply: dict) -> None:
         fut = None
         with self._lock:
             # Any surviving return's entry holds the shared future
             # (individual returns are forgotten as their refs are GC'd).
-            for ret in spec["returns"]:
+            for ret in returns:
                 entry = self._futures.get(ret)
                 if entry is not None:
                     fut = entry[0]
@@ -568,12 +945,20 @@ class DirectTaskManager:
         fut, _ = entry
 
         def _publish(_fut):
-            try:
-                self.ensure_published(oid)
-            except Exception:
-                pass
+            # Hop to the requester thread: done-callbacks may fire on
+            # the hub thread (inline batch replies), and
+            # ensure_published makes BLOCKING calls whose replies only
+            # the hub itself could deliver — publishing inline there
+            # would self-deadlock.
+            self._enqueue_job(lambda: self._ensure_published_safe(oid))
 
         fut.add_done_callback(_publish)
+
+    def _ensure_published_safe(self, oid: ObjectID) -> None:
+        try:
+            self.ensure_published(oid)
+        except Exception:
+            pass
 
     def ensure_published(self, oid: ObjectID) -> bool:
         """Make a direct inline result globally visible (daemon object

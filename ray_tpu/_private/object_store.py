@@ -24,7 +24,6 @@ the way into numpy / `jax.numpy.asarray`.
 from __future__ import annotations
 
 import os
-import sys
 import threading
 import time
 from collections import OrderedDict
@@ -331,35 +330,31 @@ class ArenaPin:
             self._arena.unpin_idx(self._index)
 
 
-class _PinToken:
-    """Anchor object for a pin's finalizer: kept alive by every
-    _TrackedBuffer carved from the pinned object, so the pin drops
-    exactly when the last zero-copy view is garbage-collected."""
+def transfer_pin_to_exporter(pin: ArenaPin) -> None:
+    """Hand a pin's release to the lifetime of its zero-copy views.
 
-    __slots__ = ("__weakref__",)
+    Every NativeArena view is exported from a PER-PIN ctypes array
+    (`NativeArena._view`): memoryviews sliced from it — including
+    numpy arrays reconstructed over out-of-band buffers — keep that
+    exporter object alive, so a weakref.finalize on the exporter
+    fires exactly when the last zero-copy view is garbage-collected
+    (plasma's Release-on-buffer-destruction, without the PEP 688
+    wrapper this replaced — works on every supported interpreter,
+    where the old pure-Python __buffer__ path forced a full copy-out
+    below 3.12).
 
+    The finalizer must not close over the pin or its view: finalize
+    holds its callback arguments strongly, and pin -> view -> exporter
+    would pin the exporter (and the slot) forever. Only the arena
+    handle and slot index ride along; arena close() makes a late
+    unpin a guarded no-op."""
+    import weakref
 
-class _TrackedBuffer:
-    """Buffer-protocol wrapper (PEP 688, Python >=3.12) around an
-    arena slice that keeps the owning pin's token alive. Consumers
-    that reference the buffer (np.frombuffer, memoryview) keep this
-    object — and hence the pin — alive; consumers that copy (bytes)
-    let it die and the pin releases immediately."""
-
-    __slots__ = ("_mv", "_token", "__weakref__")
-
-    def __init__(self, mv: memoryview, token: _PinToken):
-        self._mv = mv
-        self._token = token
-
-    def __buffer__(self, flags):
-        return self._mv.__buffer__(flags)
-
-
-# Pure-Python __buffer__ is only honored from Python 3.12 (PEP 688);
-# earlier interpreters must copy out of the arena instead of handing
-# out views whose pin lifetime couldn't be tracked.
-TRACKED_BUFFERS_SUPPORTED = sys.version_info >= (3, 12)
+    exporter = pin.view.obj  # the ctypes array backing every slice
+    arena, index = pin._arena, pin._index  # noqa: SLF001 — same module family
+    pin.view = None
+    pin._released = True  # the exporter owns the release now
+    weakref.finalize(exporter, arena.unpin_idx, index)
 
 
 class NativeArenaStore:

@@ -612,6 +612,14 @@ class RpcServer:
     def __init__(self, address: str, auth_key: Optional[bytes] = None):
         self.auth_key = auth_key or default_auth_key()
         self._handlers: Dict[str, Callable] = {}
+        #: Methods whose handlers run INLINE on the hub thread instead
+        #: of hopping through the connection queue + executor pool.
+        #: Only for handlers that never block (a queue.put): the task
+        #: hot path pays one thread wakeup, not two. Inline frames
+        #: preserve arrival order with each other; a connection mixing
+        #: inline and pooled methods loses cross-kind ordering, so
+        #: only register methods whose senders don't rely on it.
+        self._inline_handlers: Dict[str, Callable] = {}
         self._connections: Dict[int, "Connection"] = {}
         self._conn_counter = 0
         self._lock = threading.Lock()
@@ -674,8 +682,12 @@ class RpcServer:
             thread.start()  # server already running: serve immediately
         return canonical
 
-    def register(self, method: str, handler: Callable) -> None:
+    def register(
+        self, method: str, handler: Callable, inline: bool = False
+    ) -> None:
         self._handlers[method] = handler
+        if inline:
+            self._inline_handlers[method] = handler
 
     def start(self) -> None:
         self._started = True
@@ -887,6 +899,46 @@ class Connection:
 
     # -- hub callbacks (hub thread: enqueue only, never block) --------
     def _on_frame(self, msg: dict) -> None:
+        method = msg.get("_method", "")
+        inline = self._server._inline_handlers.get(method)
+        if inline is not None:
+            # Hot path (e.g. execute_tasks -> task_queue.put): the
+            # handler is non-blocking by contract, so it runs right
+            # here and the frame skips the queue + pool wakeup. Runs
+            # AHEAD of any still-queued pooled frames — inline methods
+            # are registered only where that reordering is harmless.
+            # Telemetry parity with _dispatch: the hottest RPC in the
+            # system must not vanish from event stats / the flight
+            # recorder just because it dispatches inline.
+            err = _schema_validate(method, msg)
+            mid = msg.get("_mid")
+            if err is not None:
+                if mid:
+                    self.reply(mid, {"_error": f"schema violation: {err}"})
+                return
+            t0 = time.monotonic()
+            try:
+                result = inline(self, msg)
+            except Exception as e:  # noqa: BLE001 — to caller
+                import traceback
+
+                exec_s = time.monotonic() - t0
+                _event_stats().record(method, 0.0, exec_s, error=True)
+                _flight().record(
+                    "rpc.server", method, exec_s * 1e3, {"error": True}
+                )
+                if mid:
+                    self.reply(
+                        mid,
+                        {"_error": f"{e}\n{traceback.format_exc()}"},
+                    )
+                return
+            exec_s = time.monotonic() - t0
+            _event_stats().record(method, 0.0, exec_s)
+            _flight().record("rpc.server", method, exec_s * 1e3)
+            if result is not DEFERRED and mid:
+                self.reply(mid, result or {})
+            return
         self._enqueue(msg)
 
     def _on_close(self) -> None:
@@ -1033,17 +1085,36 @@ class RpcClient:
             if self._push_handler is not None:
                 self._enqueue_work(("push", msg))
             return
+        partial = msg.get("_part")
         with self._lock:
             event = self._pending.pop(mid, None)
             if event is not None:
                 self._replies[mid] = msg
-            callback = self._pending_cb.pop(mid, None)
-            if callback is not None:
-                self._pending_gen.pop(mid, None)
+            if partial:
+                # Streamed partial reply (execute_tasks outcome
+                # parts): the callback stays registered until the
+                # final frame so it fires once per part.
+                entry = self._pending_cb.get(mid)
+            else:
+                entry = self._pending_cb.pop(mid, None)
+                if entry is not None:
+                    self._pending_gen.pop(mid, None)
         if event is not None:
             event.set()
-        if callback is not None:
-            self._enqueue_work(("cb", callback, msg))
+        if entry is not None:
+            callback, inline = entry
+            if inline:
+                # Caller opted into hub-thread delivery (call_async
+                # inline=True): the reply is handled with zero thread
+                # hops. The callback must be near-non-blocking — the
+                # batch submit path's window bounds any send it makes
+                # to buffers the peer is actively draining.
+                try:
+                    callback(msg)
+                except Exception:
+                    pass
+            else:
+                self._enqueue_work(("cb", callback, msg))
 
     def _hub_closed(self, gen: int) -> None:
         # Connection lost: wake all waiters with an error — but only
@@ -1058,7 +1129,7 @@ class RpcClient:
                 event.set()
             self._pending.clear()
             self._pending_gen.clear()
-            callbacks = list(self._pending_cb.values())
+            callbacks = [cb for cb, _inline in self._pending_cb.values()]
             self._pending_cb.clear()
         for callback in callbacks:
             self._enqueue_work(
@@ -1237,13 +1308,19 @@ class RpcClient:
             return self._replies.pop(mid)
 
     def call_async(
-        self, method: str, callback: Callable[[dict], None], **kwargs
+        self,
+        method: str,
+        callback: Callable[[dict], None],
+        inline: bool = False,
+        **kwargs,
     ) -> None:
         """Fire a request and invoke `callback(reply)` on the reader
         thread when the response arrives (or with
         ``{"_error": "__connection_lost__"}`` on connection loss). The
         hot path of the direct task transport: no per-call thread
-        handoff on the send side."""
+        handoff on the send side. ``inline=True`` additionally invokes
+        the callback straight on the hub thread (zero handoffs on the
+        reply side) — only for near-non-blocking callbacks."""
         if _chaos_should_fail(method):
             # Same contract as a send failure: the callback fires
             # synchronously on the caller's thread (callers already
@@ -1271,7 +1348,7 @@ class RpcClient:
                 return
             self._mid += 1
             mid = self._mid
-            self._pending_cb[mid] = callback
+            self._pending_cb[mid] = (callback, inline)
         msg = dict(kwargs)
         msg["_method"] = method
         msg["_mid"] = mid
@@ -1286,7 +1363,7 @@ class RpcClient:
                 dead = self._pending_cb.pop(mid, None)
                 self._pending_gen.pop(mid, None)
             if dead is not None:
-                dead({"_error": "__connection_lost__"})
+                dead[0]({"_error": "__connection_lost__"})
 
     def notify(self, method: str, **kwargs) -> None:
         """Fire-and-forget message (no reply expected)."""
@@ -1358,7 +1435,7 @@ class RpcClient:
                     stale_cbs = []
                     for mid, g in list(self._pending_gen.items()):
                         if g < gen and mid in self._pending_cb:
-                            stale_cbs.append(self._pending_cb.pop(mid))
+                            stale_cbs.append(self._pending_cb.pop(mid)[0])
                             self._pending_gen.pop(mid, None)
             for cb in stale_cbs:
                 try:

@@ -1,0 +1,169 @@
+"""Driver-side submit pipeline for the daemon task path.
+
+Coalesces task specs into `submit_tasks` batch RPCs so one wire round
+trip covers N submissions (reference: the CoreWorker submit path pays
+one raylet round trip per task; ROADMAP item 3 measured that cost at
+3-4x vs actor calls). Engaged transparently by `worker.submit_task`
+for specs the direct transport cannot take (scheduling strategies,
+TPU gangs, runtime envs, `use_direct_calls=False`); `.remote()`
+callers change nothing.
+
+Semantics:
+
+* Specs flush in submission order on one connection; the daemon's
+  per-connection ordered drain preserves batch order, so per-driver
+  submission order is preserved.
+* A batch is an envelope, not a semantic unit: per-spec decode
+  failures come back as {index: error} and seal only that spec's
+  returns; the other specs in the batch proceed.
+* Transport failures retry the WHOLE batch (bounded, with backoff);
+  head-side ingestion dedups by task_id, so a batch whose first
+  attempt half-landed re-ingests only the missing specs —
+  exactly-once.
+* A bounded in-flight window (config submit_inflight_batches) is the
+  backpressure: beyond it specs queue driver-side, absorbing floods
+  without flooding the wire.
+
+Kill switch: config task_submit_batching=False keeps the old blocking
+per-task `submit_task` RPC (`worker.submit_task` never constructs this
+pipeline then).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+from .task_spec import make_error_payload
+from .wire import encode_spec, encode_spec_batch
+
+#: Transport-level retries per batch before failing its specs.
+_BATCH_RETRIES = 3
+
+
+class _Entry:
+    __slots__ = ("blob", "returns")
+
+    def __init__(self, blob: bytes, returns: list):
+        self.blob = blob
+        self.returns = returns
+
+
+class SubmitPipeline:
+    """Batched, pipelined `submit_tasks` sender (one per driver)."""
+
+    def __init__(self, core):
+        self._core = core
+        cfg = core.config
+        self._batch_max = max(1, cfg.submit_batch_max_specs)
+        self._window = max(1, cfg.submit_inflight_batches)
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+        self._inflight = 0  # batches currently on the wire
+        self._idle = threading.Event()  # set when queue+inflight empty
+        self._idle.set()
+        self._closed = False
+
+    # -- submission ----------------------------------------------------
+    def submit(self, spec: dict) -> None:
+        entry = _Entry(encode_spec(spec), spec["returns"])
+        batch = None
+        with self._lock:
+            self._queue.append(entry)
+            self._idle.clear()
+            if self._inflight < self._window:
+                self._inflight += 1
+                batch = self._take_locked()
+        if batch:
+            self._send(batch, _BATCH_RETRIES)
+
+    def _take_locked(self) -> List[_Entry]:
+        n = min(self._batch_max, len(self._queue))
+        pop = self._queue.popleft
+        return [pop() for _ in range(n)]
+
+    def _send(self, batch: List[_Entry], retries_left: int) -> None:
+        self._core._client.call_async(
+            "submit_tasks",
+            lambda reply: self._on_reply(batch, retries_left, reply),
+            specs=encode_spec_batch(e.blob for e in batch),
+            count=len(batch),
+        )
+
+    # -- replies -------------------------------------------------------
+    def _on_reply(self, batch, retries_left: int, reply: dict) -> None:
+        err = reply.get("_error")
+        if err is not None and retries_left > 0 and err in (
+            "__chaos_injected_failure__",
+            "__connection_lost__",
+        ):
+            # Whole-batch transport retry: head ingestion dedups by
+            # task_id, so re-sending a half-landed batch is
+            # exactly-once. Backoff rides a timer thread — reply
+            # callbacks must not sleep on the RPC work pool.
+            if err == "__connection_lost__":
+                try:
+                    self._core._client._reconnect()
+                except Exception:
+                    pass
+            timer = threading.Timer(
+                0.05 * (_BATCH_RETRIES - retries_left + 1),
+                self._send,
+                args=(batch, retries_left - 1),
+            )
+            timer.daemon = True  # never block interpreter exit
+            timer.start()
+            return
+        if err is not None:
+            # Out of retries (or a handler error): fail each spec's
+            # returns individually — error semantics stay per-spec.
+            payload = make_error_payload(
+                "TaskError", f"batch submission failed: {err}"
+            )
+            for entry in batch:
+                self._seal_errors(entry, payload)
+        else:
+            for index, detail in (reply.get("errors") or {}).items():
+                # Per-spec ingest failure (malformed blob): only this
+                # spec's returns fail.
+                self._seal_errors(
+                    batch[int(index)],
+                    make_error_payload(
+                        "TaskError", f"spec rejected by head: {detail}"
+                    ),
+                )
+        next_batch = None
+        with self._lock:
+            if self._queue and not self._closed:
+                next_batch = self._take_locked()
+            else:
+                self._inflight -= 1
+                if self._inflight == 0 and not self._queue:
+                    self._idle.set()
+        if next_batch:
+            self._send(next_batch, _BATCH_RETRIES)
+
+    def _seal_errors(self, entry: _Entry, payload: bytes) -> None:
+        for ret in entry.returns:
+            try:
+                self._core._client.call(
+                    "seal_error", oid=ret, error=payload, timeout=10.0
+                )
+            except Exception:
+                # The connection is gone (the usual reason a batch
+                # exhausted its retries): every further seal would eat
+                # its own 10s timeout — up to 1024 of them for a full
+                # window — so stop after the first failure. Nothing
+                # daemon-side can answer these returns anyway.
+                return
+
+    # -- lifecycle -----------------------------------------------------
+    def flush(self, timeout: Optional[float] = 30.0) -> bool:
+        """Block until every queued spec has been accepted by the
+        daemon (or failed). Returns False on timeout."""
+        return self._idle.wait(timeout)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True

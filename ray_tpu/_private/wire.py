@@ -127,6 +127,264 @@ def decode_frame(data) -> Dict[str, Any]:
     return msg
 
 
+# -- flat task-spec codec ----------------------------------------------
+#
+# Task specs are flat dicts of bytes/str/int/float plus two structured
+# hot fields (`args`, `returns`) and a handful of cold nested options
+# (task_spec.py documents the shape). The batch submit path encodes
+# each spec with this dedicated codec instead of pickling the dict, so
+# `pickle.dumps` leaves the per-task critical path: the hot fields of
+# the common shape ride one struct-packed header + length-prefixed
+# blobs, and only the rare cold fields (scheduling_strategy,
+# runtime_env, handle_meta, ...) fall back to an embedded pickle.
+# A batch frame is the blobs joined with u32 length prefixes — the
+# outer RPC pickle then moves ONE bytes object (a memcpy), not N spec
+# dicts. `SPEC_MAGIC` is the frame kind byte; bump it when the layout
+# changes (decode refuses unknown kinds cleanly).
+
+SPEC_MAGIC = 0xF5  # flat-codec task-spec frame kind, layout v1
+
+#: Field-id table: spec keys with stable 1-byte ids. Order is
+#: append-only (ids are wire format); `ray_tpu check` RT104 and
+#: tests/test_wire_schema.py keep this table in sync with the fields
+#: the submit paths actually ship.
+SPEC_FIELDS = [
+    # hot header fields (encoded positionally, listed for the table)
+    "task_id", "job_id", "kind", "name", "function_key", "args",
+    "returns", "resources", "max_retries",
+    # tagged tail fields
+    "actor_id", "method", "ns_ctx", "num_returns_mode",
+    "concurrency_group", "max_restarts", "max_concurrency",
+    "release_creation_resources", "namespace", "class_name",
+    "handle_meta", "scheduling_strategy", "pg_context", "runtime_env",
+    "trace_ctx", "_retries_left", "concurrency_groups",
+]
+_SPEC_FID = {name: i for i, name in enumerate(SPEC_FIELDS)}
+_HOT_FIELDS = frozenset(SPEC_FIELDS[:9])
+
+_SPEC_KINDS = ["normal", "actor_creation", "actor_task", "lease"]
+_KIND_CODE = {k: i for i, k in enumerate(_SPEC_KINDS)}
+
+# magic, kind, task_id, job_id, max_retries (signed: -1 = infinite),
+# name_len, fkey_len, n_args, n_returns, n_resources
+_HOT = _struct.Struct("<BB16s4siHHIHB")
+_U32 = _struct.Struct("<I")
+_I64 = _struct.Struct("<q")
+_F64 = _struct.Struct("<d")
+
+#: Precomputed (field-id, type-tag) prefixes for the tagged tail.
+_TAIL_PFX = {
+    (name, tag): bytes((fid, tag))
+    for name, fid in _SPEC_FID.items()
+    for tag in b"NBSTFIDP"
+}
+
+
+class SpecCodecError(Exception):
+    """Blob is not a valid flat-codec task spec."""
+
+
+def encode_spec(spec: Dict[str, Any]) -> bytes:
+    """Task-spec dict -> flat blob (no pickle for the hot fields)."""
+    name = spec.get("name") or ""
+    name_b = name.encode()
+    fkey_b = (spec.get("function_key") or "").encode()
+    args = spec.get("args") or ()
+    returns = spec.get("returns") or ()
+    resources = spec.get("resources")
+    res_items = list(resources.items()) if resources else []
+    parts = [
+        _HOT.pack(
+            SPEC_MAGIC,
+            _KIND_CODE[spec["kind"]],
+            spec["task_id"],
+            spec["job_id"],
+            spec.get("max_retries") or 0,
+            len(name_b),
+            len(fkey_b),
+            len(args),
+            len(returns),
+            len(res_items),
+        ),
+        name_b,
+        fkey_b,
+    ]
+    ap = parts.append
+    u32p = _U32.pack
+    for kind, payload in args:
+        ap(b"\x00" if kind == "inline" else b"\x01")
+        ap(u32p(len(payload)))
+        ap(payload)
+    for ret in returns:
+        ap(bytes((len(ret),)))
+        ap(ret)
+    for rk, rv in res_items:
+        rkb = rk.encode()
+        ap(bytes((len(rkb),)))
+        ap(rkb)
+        ap(_F64.pack(rv))
+    for key, v in spec.items():
+        if key in _HOT_FIELDS:
+            continue
+        t = v.__class__
+        if v is None:
+            ap(_TAIL_PFX[(key, 78)])  # N
+        elif t is bytes:
+            ap(_TAIL_PFX[(key, 66)])  # B
+            ap(u32p(len(v)))
+            ap(v)
+        elif t is str:
+            vb = v.encode()
+            ap(_TAIL_PFX[(key, 83)])  # S
+            ap(u32p(len(vb)))
+            ap(vb)
+        elif t is bool:
+            ap(_TAIL_PFX[(key, 84 if v else 70)])  # T / F
+        elif t is int:
+            ap(_TAIL_PFX[(key, 73)])  # I
+            ap(_I64.pack(v))
+        elif t is float:
+            ap(_TAIL_PFX[(key, 68)])  # D
+            ap(_F64.pack(v))
+        else:
+            # Cold nested option (scheduling_strategy, runtime_env,
+            # handle_meta, ...): embedded pickle, length-prefixed —
+            # never on the hot normal-task shape.
+            pb = pickle.dumps(v, protocol=5)
+            ap(_TAIL_PFX[(key, 80)])  # P
+            ap(u32p(len(pb)))
+            ap(pb)
+    return b"".join(parts)
+
+
+def decode_spec(data: bytes) -> Dict[str, Any]:
+    """Flat blob -> task-spec dict. Raises SpecCodecError on a frame
+    that is not a v1 flat spec (unknown magic/kind/field)."""
+    try:
+        (
+            magic, kind_code, task_id, job_id, max_retries,
+            name_len, fkey_len, n_args, n_returns, n_res,
+        ) = _HOT.unpack_from(data, 0)
+        if magic != SPEC_MAGIC:
+            raise SpecCodecError(f"bad spec magic {magic:#x}")
+        pos = _HOT.size
+        name = data[pos:pos + name_len].decode()
+        pos += name_len
+        fkey = data[pos:pos + fkey_len].decode()
+        pos += fkey_len
+        u32uf = _U32.unpack_from
+        args = []
+        for _ in range(n_args):
+            akind = "inline" if data[pos] == 0 else "ref"
+            (ln,) = u32uf(data, pos + 1)
+            pos += 5
+            args.append((akind, data[pos:pos + ln]))
+            pos += ln
+        returns = []
+        for _ in range(n_returns):
+            ln = data[pos]
+            pos += 1
+            returns.append(data[pos:pos + ln])
+            pos += ln
+        resources = {}
+        for _ in range(n_res):
+            kl = data[pos]
+            pos += 1
+            rk = data[pos:pos + kl].decode()
+            pos += kl
+            (rv,) = _F64.unpack_from(data, pos)
+            pos += 8
+            resources[rk] = rv
+        spec = {
+            "task_id": task_id,
+            "job_id": job_id,
+            "kind": _SPEC_KINDS[kind_code],
+            "name": name,
+            "function_key": fkey,
+            "args": args,
+            "returns": returns,
+            "resources": resources,
+            "max_retries": max_retries,
+        }
+        end = len(data)
+        fields = SPEC_FIELDS
+        while pos < end:
+            key = fields[data[pos]]
+            tag = data[pos + 1]
+            pos += 2
+            if tag == 78:  # N
+                spec[key] = None
+            elif tag == 66:  # B
+                (ln,) = u32uf(data, pos)
+                pos += 4
+                spec[key] = data[pos:pos + ln]
+                pos += ln
+            elif tag == 83:  # S
+                (ln,) = u32uf(data, pos)
+                pos += 4
+                spec[key] = data[pos:pos + ln].decode()
+                pos += ln
+            elif tag == 84:  # T
+                spec[key] = True
+            elif tag == 70:  # F
+                spec[key] = False
+            elif tag == 73:  # I
+                (spec[key],) = _I64.unpack_from(data, pos)
+                pos += 8
+            elif tag == 68:  # D
+                (spec[key],) = _F64.unpack_from(data, pos)
+                pos += 8
+            elif tag == 80:  # P
+                (ln,) = u32uf(data, pos)
+                pos += 4
+                spec[key] = pickle.loads(data[pos:pos + ln])
+                pos += ln
+            else:
+                raise SpecCodecError(f"unknown tail tag {tag:#x}")
+        return spec
+    except SpecCodecError:
+        raise
+    except Exception as e:
+        raise SpecCodecError(f"malformed spec blob: {e!r}") from e
+
+
+def encode_spec_batch(blobs) -> bytes:
+    """Join pre-encoded spec blobs into one length-prefixed frame
+    payload: the outer RPC pickle moves a single bytes object."""
+    pack = _U32.pack
+    return b"".join(
+        part for blob in blobs for part in (pack(len(blob)), blob)
+    )
+
+
+def split_spec_batch(frame) -> list:
+    """Length-prefixed batch payload -> list of raw blobs (framing
+    errors raise SpecCodecError; per-blob decode stays the caller's so
+    one malformed spec can fail alone instead of killing the batch)."""
+    blobs = []
+    pos = 0
+    end = len(frame)
+    u32uf = _U32.unpack_from
+    try:
+        while pos < end:
+            (ln,) = u32uf(frame, pos)
+            pos += 4
+            if pos + ln > end:
+                raise SpecCodecError("truncated batch frame")
+            blobs.append(frame[pos:pos + ln])
+            pos += ln
+    except SpecCodecError:
+        raise
+    except Exception as e:
+        raise SpecCodecError(f"malformed batch frame: {e!r}") from e
+    return blobs
+
+
+def decode_spec_batch(frame) -> list:
+    """Length-prefixed batch payload -> list of spec dicts."""
+    return [decode_spec(blob) for blob in split_spec_batch(frame)]
+
+
 # -- per-method argument schemas ---------------------------------------
 #
 # field spec: name -> type or tuple of accepted types. A leading "?"
@@ -160,6 +418,9 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "release_lease": {"lease_id": str},
     "actor_address": {"actor_id": bytes},
     "execute_task": {"spec": dict},
+    # Batched direct execution on a leased worker: flat-codec batch
+    # payload; the deferred reply carries per-spec outcomes in order.
+    "execute_tasks": {"specs": bytes, "count": int},
     # on-demand profiling (reference: dashboard reporter
     # profile_manager — py-spy/memray attach; here in-process)
     "profile": {
@@ -184,6 +445,10 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     },
     "seal_error": {"oid": bytes, "error": bytes},
     "get_object": {"oid": bytes},
+    # Batched non-blocking get: one round trip resolves N refs (the
+    # worker's arg-fetch path); unsealed oids come back as pending
+    # markers and the caller falls back to blocking get_object.
+    "get_objects": {"oids": list},
     "get_object_meta": {"oid": bytes},
     "pull_object": {"oid": bytes, "?offset": int, "?length": int},
     "delete_object": {"oid": bytes},
@@ -197,6 +462,12 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "del_ref": {"oids": list},
     # task plane
     "submit_task": {"spec": dict},
+    # Batched submission: `specs` is a flat-codec batch payload
+    # (length-prefixed SPEC_MAGIC blobs, see encode_spec_batch) and
+    # `count` its spec count; per-spec failures ride back in the reply
+    # as {index: error} so error semantics stay per-spec. Ingestion is
+    # idempotent by task_id — a retried batch is exactly-once.
+    "submit_tasks": {"specs": bytes, "count": int},
     "schedule_task": {"spec": dict},
     "task_finished": {"task_id": bytes, "?had_error": bool},
     "task_done": {
@@ -205,7 +476,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     },
     "cancel_task": {"task_id": bytes},
     "cancel_local": {"task_id": bytes},
-    "task_event": {"events": list},
+    "task_event": {"events": list, "?finished": int, "?failed": int},
     "task_counts": {"?finished": int, "?failed": int},
     "span_event": {"spans": list},
     "list_spans": {"?limit": int},

@@ -48,6 +48,13 @@ _global_lock = threading.Lock()  # rt: noqa[RT004] — held for one pointer swap
 #: a flat arg list; see api_internal._flatten_args).
 KWARGS_MARKER = "__kwargs__"
 
+#: Reusable stateless context for tasks with no runtime env (the
+#: overwhelming hot path): nullcontext holds no per-entry state, so
+#: one instance serves every task.
+import contextlib as _contextlib  # noqa: E402
+
+_NULL_CTX = _contextlib.nullcontext()
+
 #: The anonymous session namespace (reference: ray's job config uses
 #: an empty/anonymous namespace unless ray.init(namespace=...) names
 #: one). Named here once; everywhere else resolves through the
@@ -73,12 +80,18 @@ _ASYNC_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
+_current_span_context = None
+
+
 def _trace_ctx() -> Optional[dict]:
     """Current span context for remote propagation (reference: ray's
     OTel integration injects the span context into task metadata)."""
-    from ..util.tracing import current_span_context
+    global _current_span_context
+    if _current_span_context is None:  # one-time import, off hot path
+        from ..util.tracing import current_span_context
 
-    ctx = current_span_context()
+        _current_span_context = current_span_context
+    ctx = _current_span_context()
     if ctx is None:
         return None
     return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
@@ -108,9 +121,127 @@ class _TaskContext(threading.local):
         # task: child submits inherit it (reference: actor.py:890
         # placement_group_capture_child_tasks).
         self.pg_context: Optional[dict] = None
+        #: Set by _serialize_ref_arg when the spec being built carries
+        #: a still-pending direct result as an arg — such specs must
+        #: ride their own frame (see direct._Pending.solo).
+        self.pending_direct_dep = False
 
 
 _worker_generation = itertools.count()
+
+
+class _BatchReply:
+    """Streams per-spec outcomes of one `execute_tasks` frame back to
+    the submitter. Outcomes accumulate and flush as PARTIAL reply
+    frames (`_part=True`, callback stays registered client-side) when
+    64 pile up, when the owning worker's 2ms batch flusher fires, or
+    — final frame, no `_part` — when the last spec completes. Eager
+    flushing is what keeps a batch from head-of-line-blocking its own
+    results: a quick spec's outcome reaches the driver (and its
+    `wait()`ers) within ~2ms even while a slow spec later in the same
+    frame is still running. Sends happen INSIDE the lock so the final
+    frame can never overtake a straggling partial on the socket."""
+
+    __slots__ = ("_conn", "_mid", "_pending", "_remaining", "_lock",
+                 "_flusher")
+
+    FLUSH_COUNT = 64
+
+    def __init__(self, conn, mid, n: int, flusher=None):
+        self._conn = conn
+        self._mid = mid
+        self._pending: List[tuple] = []
+        self._remaining = n
+        self._lock = threading.Lock()
+        self._flusher = flusher
+
+    def slot(self, index: int) -> "_BatchSlot":
+        return _BatchSlot(self, index)
+
+    def _complete(self, index: int, payload: dict) -> None:
+        arm = False
+        with self._lock:
+            self._pending.append((index, payload))
+            self._remaining -= 1
+            done = self._remaining == 0
+            if done:
+                parts, self._pending = self._pending, []
+                self._conn.reply(self._mid, {"parts": parts})
+            elif len(self._pending) >= self.FLUSH_COUNT:
+                parts, self._pending = self._pending, []
+                self._conn.reply(
+                    self._mid, {"parts": parts, "_part": True}
+                )
+            else:
+                arm = True
+        if done and self._flusher is not None:
+            self._flusher.forget(self)
+        elif arm and self._flusher is not None:
+            self._flusher.arm(self)
+
+    def flush_partial(self) -> None:
+        """Timer-driven flush of whatever has completed so far."""
+        with self._lock:
+            if not self._pending or self._remaining == 0:
+                return
+            parts, self._pending = self._pending, []
+            self._conn.reply(self._mid, {"parts": parts, "_part": True})
+
+
+class _BatchSlot:
+    """reply_to handle for one spec inside a batch: quacks like the
+    (conn, mid) deferred-reply pair `_execute` already services."""
+
+    __slots__ = ("_batch", "_index")
+
+    def __init__(self, batch: _BatchReply, index: int):
+        self._batch = batch
+        self._index = index
+
+    def reply(self, payload: dict) -> None:
+        self._batch._complete(self._index, payload)
+
+
+class _BatchFlusher:
+    """One parked thread per worker process flushing batches whose
+    outcomes sit pending behind a long-running spec: armed on the
+    first unflushed outcome, it wakes ~2ms later and ships whatever
+    has completed. Idle (parked on the event) whenever inline flushes
+    keep up — the nop-flood hot path never pays for it."""
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+        self._armed: set = set()
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self, batch: _BatchReply) -> None:
+        with self._lock:
+            self._armed.add(batch)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="rt-batch-flusher",
+                )
+                self._thread.start()
+        self._evt.set()
+
+    def forget(self, batch: _BatchReply) -> None:
+        with self._lock:
+            self._armed.discard(batch)
+
+    def _loop(self) -> None:
+        while True:
+            self._evt.wait()  # rt: noqa[RT008] — deliberate park; arm() sets the event
+            self._evt.clear()
+            time.sleep(0.002)
+            with self._lock:
+                batches, self._armed = list(self._armed), set()
+            for batch in batches:
+                try:
+                    batch.flush_partial()
+                except Exception:
+                    pass
 
 
 class CoreWorker:
@@ -169,7 +300,56 @@ class CoreWorker:
                 self._task_queue.put((msg["spec"], (conn, msg["_mid"])))
                 return DEFERRED
 
-            self._direct_server.register("execute_task", _h_direct_execute)
+            self._batch_flusher = _BatchFlusher()
+            self._reclaim_evt = threading.Event()
+            threading.Thread(
+                target=self._batch_reclaim_loop, daemon=True,
+                name="rt-batch-reclaim",
+            ).start()
+
+            def _h_direct_execute_tasks(conn, msg):
+                # Batched submission: one frame carries N flat-codec
+                # spec blobs; specs enqueue in order and outcomes
+                # stream back as partial reply frames. Error isolation
+                # lives in the outcome slots, not the envelope — a
+                # blob that fails decode (codec skew after a rolling
+                # upgrade) fails ONLY its own slot; the rest of the
+                # frame executes.
+                from .wire import (
+                    SpecCodecError,
+                    decode_spec,
+                    split_spec_batch,
+                )
+
+                blobs = split_spec_batch(msg["specs"])
+                batch = _BatchReply(
+                    conn, msg["_mid"], len(blobs),
+                    flusher=self._batch_flusher,
+                )
+                put = self._task_queue.put
+                for i, blob in enumerate(blobs):
+                    try:
+                        spec = decode_spec(blob)
+                    except SpecCodecError as e:
+                        batch.slot(i).reply({"error": make_error_payload(
+                            "TaskError", f"undecodable spec blob: {e}"
+                        )})
+                        continue
+                    put((spec, batch.slot(i)))
+                self._reclaim_evt.set()
+                return DEFERRED
+
+            # Inline dispatch: both handlers only queue.put, so they
+            # run on the hub thread — the spec reaches the task loop
+            # with ONE thread wakeup instead of two (hub -> pool ->
+            # loop). Lease connections carry nothing that orders
+            # against these frames.
+            self._direct_server.register(
+                "execute_task", _h_direct_execute, inline=True
+            )
+            self._direct_server.register(
+                "execute_tasks", _h_direct_execute_tasks, inline=True
+            )
             self._direct_server.register("ping", lambda conn, msg: {})
 
             def _h_profile(conn, msg):
@@ -245,6 +425,7 @@ class CoreWorker:
             "lock": threading.Lock(),
             "finished": 0,
             "failed": 0,
+            "events": [],
             "last_flush": 0.0,
         }
         # Workers give the daemon a LONG connect window: on an
@@ -314,6 +495,14 @@ class CoreWorker:
             from .direct import DirectTaskManager
 
             self._direct = DirectTaskManager(self)
+        # Daemon-path batch submission (specs the direct transport
+        # can't take: strategies, TPU gangs, runtime envs, or
+        # use_direct_calls=False). Kill switch: task_submit_batching.
+        self._submit_pipeline = None
+        if self.config.task_submit_batching:
+            from .submit_queue import SubmitPipeline
+
+            self._submit_pipeline = SubmitPipeline(self)
         if role == "driver":
             # Error events always flow (reference: published error
             # messages print regardless of log streaming); worker
@@ -667,31 +856,25 @@ class CoreWorker:
             return self.serialization.deserialize(view[:size].toreadonly())
         # Native arena: acquire() pins the slot. The pin must outlive
         # every zero-copy buffer carved from it — not just the fetched
-        # container — so each out-of-band buffer is wrapped in a
-        # _TrackedBuffer holding a shared token whose finalizer drops
-        # the pin (plasma ties Release to buffer destruction the same
-        # way). Values with no out-of-band buffers release immediately.
-        import weakref
-
-        from .object_store import (
-            TRACKED_BUFFERS_SUPPORTED,
-            _PinToken,
-            _TrackedBuffer,
-        )
+        # container — so its release rides the lifetime of the view's
+        # PER-PIN ctypes exporter: every memoryview sliced from the
+        # pinned view (numpy arrays reconstructed over out-of-band
+        # buffers included) keeps that exporter alive, and a finalizer
+        # on the exporter drops the pin when the last view dies
+        # (plasma ties Release to buffer destruction the same way).
+        # Values whose deserialization copies (or with no out-of-band
+        # buffers) release immediately. This replaced the pre-3.12
+        # copy-out fallback: a 64 MB get no longer pays a second
+        # memcpy on any supported interpreter.
+        from .object_store import transfer_pin_to_exporter
 
         pin = self._acquire_arena_pin(oid, deadline)
-        token = _PinToken()
         wrapped = 0
 
         def wrap(mv: memoryview):
-            if not TRACKED_BUFFERS_SUPPORTED:
-                # Pre-3.12: no PEP 688, so pin lifetime can't follow
-                # the buffer — copy out of the arena (correct, not
-                # zero-copy) and let the pin release immediately.
-                return bytes(mv)
             nonlocal wrapped
             wrapped += 1
-            return _TrackedBuffer(mv, token)
+            return mv
 
         try:
             value = self.serialization.deserialize(
@@ -701,7 +884,7 @@ class CoreWorker:
             pin.release()
             raise
         if wrapped:
-            weakref.finalize(token, pin.release)
+            transfer_pin_to_exporter(pin)
         else:
             pin.release()
         return value
@@ -892,6 +1075,10 @@ class CoreWorker:
         # object table when it lands, so the executing worker's fetch
         # resolves (chains stay pipelined; reference: the owner
         # resolves dependencies asynchronously, dependency_resolver.cc).
+        # The dependent spec must ship in its own frame: batched
+        # behind other specs, its in-worker wait could deadlock
+        # against the very reply that publishes this result.
+        self._ctx.pending_direct_dep = True
         self._direct.publish_when_done(arg.id())
         return ("ref", arg.binary())
 
@@ -936,6 +1123,8 @@ class CoreWorker:
         returns = [
             ObjectID.for_return(task_id, i + 1) for i in range(n_declared)
         ]
+        self._ctx.pending_direct_dep = False
+        wire_args = self._serialize_args(args)
         # Optional fields enter the spec only when set: every consumer
         # reads them via .get() (absent == None), and at the 1M-queued
         # scale the dead entries cost ~100 B/task of driver+head RSS.
@@ -945,7 +1134,7 @@ class CoreWorker:
             "kind": "normal",
             "name": name,
             "function_key": func_key,
-            "args": self._serialize_args(args),
+            "args": wire_args,
             "returns": [r.binary() for r in returns],
             # `resources={}` is a real request (zero-resource task; the
             # reference schedules these anywhere, ray_option_utils.py
@@ -976,7 +1165,9 @@ class CoreWorker:
         if self._direct is not None and self._direct.eligible(spec):
             fut = self._direct.register(spec)
             fut.hold_refs = [a for a in args if isinstance(a, ObjectRef)]
-            self._direct.submit(spec)
+            self._direct.submit(spec, solo=self._ctx.pending_direct_dep)
+        elif self._submit_pipeline is not None:
+            self._submit_pipeline.submit(spec)
         else:
             self._client.call("submit_task", spec=spec)
         return [ObjectRef(r, owner=self) for r in returns]
@@ -1153,6 +1344,49 @@ class CoreWorker:
         executing, if any."""
         return getattr(self._ctx, "pg_context", None)
 
+    def _batch_reclaim_loop(self) -> None:
+        """Hand queued-but-unstarted batch specs back to the submitter
+        when the running spec won't finish (blocking gang member, long
+        compute): the driver re-spreads them across other leases, so
+        stacking N specs on this worker can never serialize — or
+        deadlock — work the resource model promised to run
+        concurrently. Queue.get is atomic, so a spec is either
+        reclaimed here or executed by the loop, never both."""
+        q = self._task_queue
+        evt = self._reclaim_evt
+        while self._running:
+            # Parked until a batch handler queues specs — the reclaim
+            # scan only matters while work is queued BEHIND a running
+            # spec, so an idle or sequential-latency worker never pays
+            # the 40 Hz poll.
+            if q.empty():
+                evt.wait(5.0)  # rt: noqa[RT008] — deliberate park; enqueue sets the event
+                evt.clear()
+            time.sleep(0.025)
+            if q.empty() or not self._inflight_tasks:
+                continue  # idle loop drains the queue itself
+            try:
+                oldest = min(
+                    info["started"]
+                    for info in list(self._inflight_tasks.values())
+                )
+            except ValueError:
+                continue  # finished between checks
+            if time.time() - oldest < 0.05:
+                continue
+            kept = []
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None and type(item[1]) is _BatchSlot:
+                    item[1].reply({"requeue": True})
+                else:
+                    kept.append(item)  # daemon pushes / shutdown None
+            for item in kept:
+                q.put(item)
+
     def run_task_loop(self) -> None:
         """Blocking execution loop (reference:
         CoreWorkerProcess::RunTaskExecutionLoop). Consumes both
@@ -1217,71 +1451,117 @@ class CoreWorker:
             _with_task_ctx(), self._actor_loop
         ).result()
 
+    _none_bytes: Optional[bytes] = None
+
+    def _none_wire_bytes(self) -> bytes:
+        cached = self._none_bytes
+        if cached is None:
+            cached = CoreWorker._none_bytes = (
+                self.serialization.serialize(None).to_bytes()
+            )
+        return cached
+
     def _direct_reply(self, reply_to, payload: dict) -> None:
-        conn, mid = reply_to
-        conn.reply(mid, payload)
+        if type(reply_to) is tuple:
+            conn, mid = reply_to
+            conn.reply(mid, payload)
+        else:
+            reply_to.reply(payload)  # _BatchSlot of an execute_tasks frame
 
     def _report_direct_task_events(
         self, spec: dict, start: float, failed: bool
     ) -> None:
         """Direct-transport tasks never transit the daemon, so the
         executing worker reports their state events (reference:
-        task_event_buffer.h — workers batch events to the GCS)."""
-        self._count_direct_task(failed)
-        if not self.config.task_events_enabled:
-            return
-        tid = spec["task_id"]
-        base = {
-            "task_id": tid.hex() if isinstance(tid, bytes) else str(tid),
-            "name": spec.get("name", ""),
-            "kind": spec.get("kind", "normal"),
-        }
-        try:
-            self._client.notify(
-                "task_event",
-                events=[
-                    dict(base, state="RUNNING", time=start),
-                    dict(
-                        base,
-                        state="FAILED" if failed else "FINISHED",
-                        time=time.time(),
-                    ),
-                ],
-            )
-        except Exception:
-            pass
-
-    def _count_direct_task(self, failed: bool) -> None:
-        """Core-metrics counting decoupled from the (disableable)
-        task-event stream: completions accumulate locally and flush
-        as ONE tiny notify when the task queue drains or 0.5s passes
-        — zero per-task RPC at full throughput, yet counts land
-        promptly after a burst (metric_defs rt_tasks_*_total)."""
+        task_event_buffer.h — workers batch events to the GCS). Events
+        AND counts accumulate locally and flush as one notify pair
+        when the queue drains (rate-limited to 20 Hz) or 0.5 s passes
+        — the per-task task_event notify this replaces was its own
+        control-plane flood at batched-submit rates."""
         counts = self._direct_task_counts
+        events = None
+        if self.config.task_events_enabled:
+            tid = spec["task_id"]
+            base = {
+                "task_id": tid.hex() if isinstance(tid, bytes) else str(tid),
+                "name": spec.get("name", ""),
+                "kind": spec.get("kind", "normal"),
+            }
+            events = (
+                dict(base, state="RUNNING", time=start),
+                dict(
+                    base,
+                    state="FAILED" if failed else "FINISHED",
+                    time=time.time(),
+                ),
+            )
         with counts["lock"]:
             counts["failed" if failed else "finished"] += 1
+            if events is not None:
+                counts["events"].extend(events)
             now = time.monotonic()
+            # Queue-drain flush is UNCONDITIONAL: completion events
+            # must reach the daemon before the caller's get() returns
+            # (a state/metrics query issued that instant sees the
+            # task). Mid-flood the queue is never empty, so events
+            # still coalesce into 0.5s/2048-record batches there —
+            # the regime the per-task notify was flooding.
             due = (
                 now - counts["last_flush"] >= 0.5
+                or len(counts["events"]) >= 2048
                 or self._task_queue.empty()
             )
             if not due:
                 return
             finished, failed_n = counts["finished"], counts["failed"]
+            ev_batch = counts["events"]
             counts["finished"] = counts["failed"] = 0
+            counts["events"] = []
             counts["last_flush"] = now
         try:
-            self._client.notify(
-                "task_counts", finished=finished, failed=failed_n
-            )
+            if ev_batch:
+                # One frame carries both events and counts.
+                self._client.notify(
+                    "task_event", events=ev_batch,
+                    finished=finished, failed=failed_n,
+                )
+            else:
+                self._client.notify(
+                    "task_counts", finished=finished, failed=failed_n
+                )
+        except Exception:  # noqa: BLE001 — metrics must not raise
+            pass
+
+    def flush_task_events(self) -> None:
+        """Force-flush buffered direct-task events/counts (tests and
+        state-API consumers that need completion events NOW rather
+        than at the next 50ms/queue-drain flush)."""
+        counts = self._direct_task_counts
+        with counts["lock"]:
+            finished, failed_n = counts["finished"], counts["failed"]
+            ev_batch = counts["events"]
+            counts["finished"] = counts["failed"] = 0
+            counts["events"] = []
+            counts["last_flush"] = time.monotonic()
+        try:
+            if ev_batch:
+                self._client.notify(
+                    "task_event", events=ev_batch,
+                    finished=finished, failed=failed_n,
+                )
+            elif finished or failed_n:
+                self._client.notify(
+                    "task_counts", finished=finished, failed=failed_n
+                )
         except Exception:  # noqa: BLE001 — metrics must not raise
             pass
 
     def _execute(self, spec: dict, reply_to=None) -> None:
         start_time = time.time()
         task_id = TaskID(spec["task_id"])
-        self._inflight_tasks[task_id.hex()] = {
-            "task_id": task_id.hex(),
+        tid_hex = task_id.hex()
+        self._inflight_tasks[tid_hex] = {
+            "task_id": tid_hex,
             "name": spec.get("name", ""),
             "kind": spec.get("kind", "normal"),
             "started": start_time,
@@ -1306,11 +1586,10 @@ class CoreWorker:
             self.namespace = self._actor_namespace or DEFAULT_NAMESPACE
         else:
             self.namespace = spec.get("ns_ctx") or DEFAULT_NAMESPACE
-        self.job_id = JobID(spec["job_id"])
+        if self.job_id._bytes != spec["job_id"]:
+            self.job_id = JobID(spec["job_id"])
         trace_stack = None
         try:
-            from .runtime_env import apply_runtime_env
-
             tctx = spec.get("trace_ctx")
             if tctx:
                 # Execution span linked under the caller's span
@@ -1329,12 +1608,19 @@ class CoreWorker:
             args, kwargs = _split_kwargs(self._deserialize_args(spec["args"]))
             kind = spec["kind"]
             # Actors keep their runtime env for life (they pin this
-            # worker); shared task workers restore afterwards.
-            with apply_runtime_env(
-                spec.get("runtime_env"),
-                self,
-                restore=(kind != "actor_creation"),
-            ):
+            # worker); shared task workers restore afterwards. The
+            # env-less hot path skips the contextmanager machinery
+            # entirely (a reusable nullcontext has no enter state).
+            renv = spec.get("runtime_env")
+            if renv:
+                from .runtime_env import apply_runtime_env
+
+                env_ctx = apply_runtime_env(
+                    renv, self, restore=(kind != "actor_creation")
+                )
+            else:
+                env_ctx = _NULL_CTX
+            with env_ctx:
                 if kind == "actor_creation":
                     cls = self.functions.fetch(spec["function_key"])
                     self._actor_instance = cls(*args, **kwargs)
@@ -1420,7 +1706,7 @@ class CoreWorker:
         finally:
             if trace_stack is not None:
                 trace_stack.close()
-            self._inflight_tasks.pop(task_id.hex(), None)
+            self._inflight_tasks.pop(tid_hex, None)
             rec = _flight()
             if rec.enabled:
                 rec.record(
@@ -1441,6 +1727,12 @@ class CoreWorker:
             try:
                 wire = []
                 for oid_bytes, value in zip(spec["returns"], results):
+                    if value is None:
+                        # The nop/side-effect-task result: one cached
+                        # wire blob instead of a fresh cloudpickle per
+                        # task at batched-execute rates.
+                        wire.append(("inline", self._none_wire_bytes()))
+                        continue
                     serialized = self.serialization.serialize(value)
                     size = serialized.total_size()
                     if size <= self.config.max_direct_call_object_size:
@@ -1477,12 +1769,85 @@ class CoreWorker:
 
     def _deserialize_args(self, wire_args: List[tuple]) -> List[Any]:
         args = []
+        ref_slots: List[int] = []
+        ref_blobs: List[bytes] = []
+        deserialize = self.serialization.deserialize
         for kind, payload in wire_args:
             if kind == "inline":
-                args.append(self.serialization.deserialize(payload))
+                args.append(deserialize(payload))
             else:
-                args.append(self._get_one(ObjectID(payload), timeout=None))
+                ref_slots.append(len(args))
+                ref_blobs.append(payload)
+                args.append(None)
+        if not ref_slots:
+            return args
+        if len(ref_slots) == 1:
+            args[ref_slots[0]] = self._get_one(
+                ObjectID(ref_blobs[0]), timeout=None
+            )
+            return args
+        for slot, value in zip(ref_slots, self._get_many(ref_blobs)):
+            args[slot] = value
         return args
+
+    def _get_many(self, oid_blobs: List[bytes]) -> List[Any]:
+        """Resolve many refs with ONE `get_objects` round trip for
+        everything the daemon already holds (the many-arg task path:
+        per-arg blocking gets made one 10k-arg task cost 10k RTTs).
+        Unready/remote entries fall back to the blocking per-oid get,
+        which pulls and waits exactly like before."""
+        # The RPC is deduped per unique oid, but DESERIALIZATION runs
+        # once per arg position: duplicate ref args must stay
+        # independent objects (a task mutating args[0] in place must
+        # not see the change through args[1] — the per-arg blocking
+        # path always gave fresh deserializations).
+        inline_payloads: Dict[bytes, Any] = {}
+        shm_sizes: Dict[bytes, int] = {}
+        unique = list(dict.fromkeys(oid_blobs))
+        remote: List[bytes] = []
+        for blob in unique:
+            oid = ObjectID(blob)
+            with self._ref_lock:
+                cached = self._inline_cache.get(oid)
+            if cached is not None:
+                inline_payloads[blob] = cached
+            else:
+                remote.append(blob)
+        if remote:
+            try:
+                reply = self._client.call(
+                    "get_objects", oids=remote, timeout=120.0
+                )
+                results = reply.get("results") or []
+            except RpcError:
+                results = []
+            for blob, res in zip(remote, results):
+                if res.get("error") is not None:
+                    raise_from_payload(res["error"])
+                if res.get("inline") is not None:
+                    inline_payloads[blob] = res["inline"]
+                elif res.get("shm_size") is not None:
+                    shm_sizes[blob] = res["shm_size"]
+                # pending: blocking fallback below
+        out = []
+        for blob in oid_blobs:
+            if blob in inline_payloads:
+                out.append(
+                    self.serialization.deserialize(inline_payloads[blob])
+                )
+            elif blob in shm_sizes:
+                try:
+                    out.append(self._read_local_store(
+                        ObjectID(blob), shm_sizes[blob], 30.0
+                    ))
+                except (FileNotFoundError, exc.GetTimeoutError):
+                    # evicted mid-fetch: blocking path re-pulls
+                    out.append(
+                        self._get_one(ObjectID(blob), timeout=None)
+                    )
+            else:
+                out.append(self._get_one(ObjectID(blob), timeout=None))
+        return out
 
     def _collect_returns(
         self, task_id: TaskID, spec: dict, value: Any
@@ -1533,6 +1898,12 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self.flush_pending_dels()
+        if self._submit_pipeline is not None:
+            # Queued batch submissions must reach the daemon before
+            # the connection dies (their returns are already handed
+            # out as ObjectRefs).
+            self._submit_pipeline.flush(5.0)
+            self._submit_pipeline.shutdown()
         self._running = False
         self._del_flush_evt.set()  # unpark the flusher so it exits
         if self._direct is not None:

@@ -19,6 +19,11 @@ class RemoteFunction:
         # being silently merged and ignored at submission.
         validate_options("task", self._options)
         self._exported_key: Optional[str] = None
+        #: (generation, func_key, name, num_returns, resources,
+        #: max_retries) — resolved-once submit plan for static options
+        #: (api_internal.submit_function hot path). Never copied by
+        #: .options(): a clone's options differ by construction.
+        self._submit_plan = None
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):

@@ -58,9 +58,35 @@ def test_schema_registry_covers_every_registered_method():
         src, re.S,
     ).group(1)
     methods = set(re.findall(r'"([a-z_]+)"', block))
-    methods |= {"_disconnect", "execute_task", "ping"}
+    methods |= {"_disconnect", "execute_task", "execute_tasks", "ping"}
     missing = sorted(m for m in methods if m not in wire.SCHEMAS)
     assert not missing, f"methods without wire schema: {missing}"
+
+
+def test_batch_submit_schemas_registered():
+    """The batched task plane rides typed schemas (RT104 judges its
+    call sites against these): `specs` is the flat-codec batch payload
+    — ONE bytes blob, never a pickled list of dicts."""
+    for method in ("submit_tasks", "execute_tasks"):
+        assert wire.SCHEMAS[method]["specs"] is bytes
+        assert wire.SCHEMAS[method]["count"] is int
+    assert wire.SCHEMAS["get_objects"]["oids"] is list
+
+
+def test_flat_codec_frame_kind_is_guarded():
+    """The flat-codec frame kind byte is wire format: decode must
+    refuse any other kind cleanly (SchemaError-class failure, not a
+    struct unpack deep in a handler), and a codec-encoded spec always
+    leads with it."""
+    spec = {
+        "task_id": b"T" * 16, "job_id": b"J" * 4, "kind": "normal",
+        "name": "f", "function_key": "k", "args": [], "returns": [],
+        "resources": {}, "max_retries": 0,
+    }
+    blob = wire.encode_spec(spec)
+    assert blob[0] == wire.SPEC_MAGIC
+    with pytest.raises(wire.SpecCodecError, match="magic"):
+        wire.decode_spec(bytes([wire.SPEC_MAGIC ^ 0xFF]) + blob[1:])
 
 
 def test_validate_types_and_required():
