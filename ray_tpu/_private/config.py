@@ -179,6 +179,11 @@ class Config:
     #: Snapshots retained in the head time-series ring (oldest evict
     #: first; 720 x 5 s = a one-hour window by default).
     metrics_timeseries_max_snapshots: int = 720
+    #: Kill switch for the continuous-batching LLM serving engine
+    #: (ray_tpu/llm): RT_serve_engine_enabled=0 makes `build_llm_app`
+    #: deployments fall back to per-request `generate_stream()` — the
+    #: serialize-per-request baseline servebench.py compares against.
+    serve_engine_enabled: bool = True
 
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`

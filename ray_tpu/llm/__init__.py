@@ -1,0 +1,31 @@
+"""LLM inference serving: continuous-batching engine + serve glue.
+
+The engine (engine.py) owns a slot-arranged KV cache (kv_slots.py)
+fed by a FIFO slot scheduler (scheduler.py); serving.py wires it
+behind `ray_tpu.serve` as a multiplexed streaming deployment, and
+`servebench.py` at the repo root drives it with open-loop Poisson
+traffic (results in SERVEBENCH.json).
+"""
+
+from .engine import (
+    EngineConfig,
+    EngineDead,
+    EngineOverloaded,
+    InferenceEngine,
+    TokenStream,
+)
+from .scheduler import SlotScheduler
+from .kv_slots import SlotKVCache
+from .serving import LLMServer, build_llm_app
+
+__all__ = [
+    "EngineConfig",
+    "EngineDead",
+    "EngineOverloaded",
+    "InferenceEngine",
+    "TokenStream",
+    "SlotScheduler",
+    "SlotKVCache",
+    "LLMServer",
+    "build_llm_app",
+]

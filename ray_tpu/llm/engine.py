@@ -1,0 +1,583 @@
+"""Continuous-batching LLM inference engine.
+
+The missing middle of the serving story (ROADMAP item 1): today each
+request runs `models/generate.generate()` alone, so concurrent
+requests serialize and decode occupancy collapses. This engine owns
+ONE shared KV cache arranged as fixed-shape slots (kv_slots.py) and
+runs a background step loop that, every iteration:
+
+  1. reaps cancellations and frees their slots immediately;
+  2. admits the FIFO head of the waiting queue into a free slot and
+     advances its prefill by ONE fixed-size chunk (Sarathi-style:
+     prefill chunks interleave with the running decode batch instead
+     of stalling it for a whole long prompt);
+  3. runs ONE jitted decode step over the FULL slot batch (static
+     shape; dead slots ride along masked) — the same
+     `models/generate.decode_step` that `generate`/`generate_stream`
+     use — and streams each live row's sampled token to its request's
+     consumer queue;
+  4. retires rows that hit EOS / their token budget, freeing slots in
+     the same iteration.
+
+Requests are host-side objects; per-request state on device is one
+row of the slot cache + one row of `last_logits`. Sampling parameters
+(temperature/top_k) are engine-level statics — they are jit statics
+in the shared kernel, and per-request values would force per-row
+sampling programs (documented trade; greedy is the serving default).
+
+Threading: submit()/cancel() may be called from any thread (serve
+replicas run handlers on a pool); all scheduler/request state is
+guarded by one lock, JAX work runs outside it. One engine = one step
+thread = one model family — a multiplexed deployment holds several
+engines, so loading family B never blocks family A's loop
+(tests/test_llm_engine.py proves it).
+
+Failure: if the step loop dies, every in-flight and queued request is
+failed with the loop's exception (consumers raise, never hang) and
+subsequent submits raise EngineDead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .kv_slots import SlotKVCache
+from .scheduler import EngineDead, EngineOverloaded, SlotScheduler
+
+__all__ = [
+    "EngineConfig",
+    "InferenceEngine",
+    "TokenStream",
+    "EngineOverloaded",
+    "EngineDead",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine admission/cache knobs (README "LLM serving engine")."""
+
+    #: Decode-batch width = max concurrently-decoding sequences.
+    slots: int = 4
+    #: Per-slot KV capacity; prompt_len + max_new_tokens must fit.
+    max_len: int = 256
+    #: Prefill chunk length. Prompts pad up to a multiple of this
+    #: (the length-bucket set), and long prompts prefill chunk-by-
+    #: chunk interleaved with decode steps.
+    prefill_chunk: int = 32
+    #: Waiting-queue bound; past it submit() raises EngineOverloaded.
+    #: Size it so worst-case queue wait stays under the serve layer's
+    #: 60 s per-chunk stream timeout (≈ max_waiting x max_new_tokens
+    #: / batched-tokens-per-s) — a deeper queue just converts shed-
+    #: fast errors into slow client timeouts that waste a slot.
+    max_waiting: int = 64
+    #: Default per-request token budget (requests may pass their own).
+    max_new_tokens: int = 64
+    #: Engine-level sampling statics (0.0 = greedy).
+    temperature: float = 0.0
+    top_k: int = 0
+    #: Default EOS token id (-1 = none); requests may override.
+    eos_token: int = -1
+    #: RNG seed for sampled decoding (ignored when greedy).
+    seed: int = 0
+    #: Idle-loop park time waiting for work.
+    idle_wait_s: float = 0.02
+
+
+class _Request:
+    __slots__ = (
+        "request_id", "prompt", "max_new_tokens", "eos_token",
+        "out", "cancelled", "submitted_ts", "first_token_ts",
+        "emitted", "slot", "bucket", "prompt_cache", "offset",
+        "padded",
+    )
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt: List[int],
+        max_new_tokens: int,
+        eos_token: int,
+    ):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_token = eos_token
+        #: Consumer stream: ("tok", id) | ("end", reason) |
+        #: ("err", exc). Unbounded — the engine must never block on a
+        #: slow consumer (that would head-of-line block the whole
+        #: decode batch); depth is bounded in practice by max_new.
+        self.out: "queue.Queue" = queue.Queue()
+        self.cancelled = threading.Event()
+        self.submitted_ts = time.perf_counter()
+        self.first_token_ts: Optional[float] = None
+        self.emitted = 0
+        # prefill progress (engine thread only)
+        self.slot: Optional[int] = None
+        self.bucket = 0
+        self.prompt_cache = None
+        self.offset = 0
+        self.padded = None
+
+
+class TokenStream:
+    """Consumer side of one request: iterate token ids as they are
+    sampled. Ends at EOS/budget/cancel; raises if the engine failed
+    the request. `finish_reason` is set once exhausted."""
+
+    def __init__(self, engine: "InferenceEngine", req: _Request):
+        self._engine = engine
+        self._req = req
+        self.finish_reason: Optional[str] = None
+
+    @property
+    def request_id(self) -> str:
+        return self._req.request_id
+
+    def __iter__(self) -> Iterator[int]:
+        return self
+
+    def __next__(self) -> int:
+        if self.finish_reason is not None:
+            raise StopIteration
+        while True:
+            try:
+                kind, value = self._req.out.get(timeout=1.0)
+                break
+            except queue.Empty:
+                # Belt-and-braces: a dead engine fails every request
+                # with a sentinel, but if this request somehow missed
+                # one the consumer must raise, not hang forever.
+                if (
+                    self._engine._dead is not None
+                    and self._req.out.empty()
+                ):
+                    self.finish_reason = "error"
+                    raise EngineDead(
+                        "engine died mid-stream"
+                    ) from self._engine._dead
+        if kind == "tok":
+            return value
+        if kind == "end":
+            self.finish_reason = value
+            raise StopIteration
+        self.finish_reason = "error"
+        raise value
+
+    def cancel(self) -> None:
+        self._engine.cancel(self._req.request_id)
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        params: Dict[str, Any],
+        cfg,
+        engine_config: Optional[EngineConfig] = None,
+        *,
+        family: str = "",
+        app: str = "",
+        deployment: str = "",
+    ):
+        import jax
+
+        ec = engine_config or EngineConfig()
+        self.params = params
+        self.cfg = cfg
+        self.config = ec
+        self.family = family
+        self._tags = {
+            "app": app, "deployment": deployment,
+            "family": family or "default",
+        }
+        self._kv = SlotKVCache(
+            cfg, ec.slots, ec.max_len, ec.prefill_chunk
+        )
+        self._sched = SlotScheduler(ec.slots, ec.max_waiting)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        # Per-slot decode state. positions/alive live host-side (the
+        # engine mutates them per admission/step); last_logits stays
+        # on device.
+        import jax.numpy as jnp
+
+        self._positions = np.zeros(ec.slots, np.int32)
+        self._alive = np.zeros(ec.slots, bool)
+        self._last_logits = jnp.zeros(
+            (ec.slots, cfg.vocab_size), jnp.float32
+        )
+        self._base_key = jax.random.PRNGKey(ec.seed)
+        self._prefilling: Optional[_Request] = None
+        self._by_id: Dict[str, _Request] = {}
+        self._steps = 0
+        self._tokens_emitted = 0
+        self._requests_done = 0
+        self._dead: Optional[BaseException] = None
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name=f"llm-engine:{family or 'default'}",
+        )
+        self._thread.start()
+
+    # -- public --------------------------------------------------------
+    def submit(
+        self,
+        prompt: List[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        eos_token: Optional[int] = None,
+        request_id: Optional[str] = None,
+    ) -> TokenStream:
+        ec = self.config
+        max_new = int(
+            ec.max_new_tokens if max_new_tokens is None
+            else max_new_tokens
+        )
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        prompt = [int(t) for t in prompt]
+        bucket = self._kv.bucket_for(len(prompt))
+        if len(prompt) + max_new > ec.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds slot capacity max_len={ec.max_len}"
+            )
+        if eos_token is not None and eos_token != int(eos_token):
+            raise ValueError(
+                f"eos_token must be integral, got {eos_token!r}"
+            )
+        req = _Request(
+            request_id or uuid.uuid4().hex[:16],
+            prompt,
+            max_new,
+            ec.eos_token if eos_token is None else int(eos_token),
+        )
+        req.bucket = bucket
+        with self._lock:
+            if self._dead is not None or self._stopping:
+                raise EngineDead(
+                    "engine is shut down"
+                ) from self._dead
+            if req.request_id in self._by_id:
+                raise ValueError(
+                    f"duplicate request_id {req.request_id!r}"
+                )
+            self._sched.submit(req)
+            self._by_id[req.request_id] = req
+        self._wake.set()
+        return TokenStream(self, req)
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or in-flight request. Queued requests end
+        immediately; running ones are reaped (slot freed) at the top
+        of the next engine iteration — mid-decode, not at stream
+        end."""
+        with self._lock:
+            req = self._by_id.get(request_id)
+            if req is None:
+                return False
+            req.cancelled.set()
+            if self._sched.remove_waiting(req):
+                self._finish_locked(req, "cancelled")
+        self._wake.set()
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = self._sched.stats()
+            out.update(
+                family=self.family,
+                steps=self._steps,
+                tokens_emitted=self._tokens_emitted,
+                requests_done=self._requests_done,
+                prefilling=self._prefilling is not None,
+                kv_bytes=self._kv.nbytes(),
+                dead=self._dead is not None,
+            )
+        return out
+
+    def close(self) -> None:
+        """Stop the loop and fail everything in flight (the multiplex
+        LRU calls this on eviction). In-flight consumers get an ERROR,
+        not a clean end — a truncated response must be detectable."""
+        with self._lock:
+            self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=30)
+        with self._lock:
+            if self._dead is None:
+                self._dead = EngineDead("engine unloaded")
+            self._fail_all_locked(
+                EngineDead("engine unloaded with request in flight")
+            )
+
+    # Multiplex eviction hook (serve/multiplex.py looks for it).
+    __serve_unload__ = close
+
+    # -- engine loop ---------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    if self._stopping:
+                        return
+                did_work = self._step()
+                if not did_work:
+                    self._wake.wait(self.config.idle_wait_s)
+                    self._wake.clear()
+        except BaseException as e:  # noqa: BLE001 — forwarded to
+            # every consumer; the loop must never die silently.
+            failure = EngineDead(f"engine loop died: {e!r}")
+            failure.__cause__ = e
+            with self._lock:
+                self._dead = e
+                self._fail_all_locked(failure)
+
+    def _step(self) -> bool:
+        """One engine iteration; returns whether any work happened."""
+        worked = self._reap_cancelled()
+        worked = self._advance_prefill() or worked
+        worked = self._decode() or worked
+        return worked
+
+    # -- cancellation / completion ------------------------------------
+    def _reap_cancelled(self) -> bool:
+        worked = False
+        with self._lock:
+            # The prefilling request is ALSO in sched.running (its
+            # slot was claimed at admission) — release it through this
+            # branch first so the loop below can't double-release the
+            # slot (release() on an already-freed slot raises and
+            # would kill the whole loop).
+            if (
+                self._prefilling is not None
+                and self._prefilling.cancelled.is_set()
+            ):
+                req = self._prefilling
+                self._prefilling = None
+                self._release_locked(req.slot, req, "cancelled")
+                worked = True
+            for slot, req in list(self._sched.running.items()):
+                if req.cancelled.is_set():
+                    self._release_locked(slot, req, "cancelled")
+                    worked = True
+        return worked
+
+    def _release_locked(
+        self, slot: int, req: _Request, reason: str
+    ) -> None:
+        self._sched.release(slot)
+        self._alive[slot] = False
+        self._finish_locked(req, reason)
+
+    def _finish_locked(self, req: _Request, reason: str) -> None:
+        self._by_id.pop(req.request_id, None)
+        self._requests_done += 1
+        req.out.put(("end", reason))
+        self._observe_finish(reason)
+        # Push occupancy from the retirement itself: cancellation/
+        # drain may leave no alive rows, so no decode step would ever
+        # publish the freed slots (the gauge throttle keeps this
+        # cheap; a slots_used zero-crossing always goes out).
+        self._observe_occupancy()
+
+    def _fail_all_locked(self, error: BaseException) -> None:
+        if self._prefilling is not None:
+            doomed = [self._prefilling]
+            self._prefilling = None
+        else:
+            doomed = []
+        doomed.extend(self._sched.drain())
+        self._alive[:] = False
+        for req in doomed:
+            self._by_id.pop(req.request_id, None)
+            req.out.put(("err", error))
+        self._observe_occupancy()
+
+    # -- prefill -------------------------------------------------------
+    def _advance_prefill(self) -> bool:
+        """Admit (if idle) and advance the current prefill by ONE
+        chunk. Returns whether prefill work happened."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            req = self._prefilling
+            if req is None:
+                admitted = self._sched.admit_next()
+                if admitted is None:
+                    return False
+                req, slot = admitted
+                req.slot = slot
+                self._prefilling = req
+        if req.prompt_cache is None:
+            req.prompt_cache = self._kv.fresh_prompt_cache(req.bucket)
+            padded = np.zeros((1, req.bucket), np.int32)
+            padded[0, : len(req.prompt)] = req.prompt
+            req.padded = padded
+        from ..models.generate import prefill
+
+        chunk = self.config.prefill_chunk
+        t0 = time.perf_counter()
+        tokens = jnp.asarray(req.padded[:, req.offset:req.offset + chunk])
+        logits, req.prompt_cache = prefill(
+            self.params,
+            self.cfg,
+            tokens,
+            req.prompt_cache,
+            jnp.int32(req.offset),
+            jnp.int32(req.offset + chunk),
+        )
+        req.offset += chunk
+        last_chunk = req.offset >= req.bucket
+        if last_chunk:
+            # Next-token logits come from the prompt's LAST REAL
+            # position (inside this chunk by bucket construction:
+            # the final chunk covers [bucket - chunk, bucket) and
+            # len(prompt) > bucket - chunk).
+            local = len(req.prompt) - 1 - (req.offset - chunk)
+            last_row = logits[0, local]
+            self._kv.insert(req.slot, req.prompt_cache)
+            self._last_logits = self._last_logits.at[req.slot].set(
+                last_row
+            )
+            last_row.block_until_ready()
+        else:
+            logits.block_until_ready()
+        self._observe_prefill(
+            (time.perf_counter() - t0) * 1e3, chunk
+        )
+        if last_chunk:
+            req.prompt_cache = None
+            req.padded = None
+            with self._lock:
+                self._prefilling = None
+                # Cancelled during the final chunk: reap now rather
+                # than decoding a dead row for one step.
+                if req.cancelled.is_set():
+                    self._release_locked(req.slot, req, "cancelled")
+                    return True
+                self._positions[req.slot] = len(req.prompt)
+                self._alive[req.slot] = True
+        return True
+
+    # -- decode --------------------------------------------------------
+    def _decode(self) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import decode_step
+
+        alive_idx = np.flatnonzero(self._alive)
+        if alive_idx.size == 0:
+            return False
+        batch = int(alive_idx.size)
+        ec = self.config
+        t0 = time.perf_counter()
+        key = jax.random.fold_in(self._base_key, self._steps)
+        token, cache, last_logits = decode_step(
+            self.params,
+            self.cfg,
+            self._kv.cache,
+            self._last_logits,
+            jnp.asarray(self._positions),
+            jnp.asarray(self._alive),
+            key,
+            temperature=ec.temperature,
+            top_k=ec.top_k,
+        )
+        self._kv.cache = cache
+        self._last_logits = last_logits
+        tokens = np.asarray(token)  # device->host sync per step
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        now = time.perf_counter()
+        emitted = 0
+        with self._lock:
+            for slot in alive_idx:
+                req = self._sched.running.get(int(slot))
+                if req is None:  # freed this iteration
+                    continue
+                tok = int(tokens[slot])
+                if req.first_token_ts is None:
+                    req.first_token_ts = now
+                    self._observe_ttft(
+                        (now - req.submitted_ts) * 1e3
+                    )
+                req.out.put(("tok", tok))
+                req.emitted += 1
+                emitted += 1
+                self._positions[slot] += 1
+                if tok == req.eos_token:
+                    self._release_locked(int(slot), req, "stop")
+                elif req.emitted >= req.max_new_tokens:
+                    self._release_locked(int(slot), req, "length")
+            self._tokens_emitted += emitted
+        self._observe_step(step_ms, batch, emitted)
+        return True
+
+    # -- metrics -------------------------------------------------------
+    # All hooks are guarded no-ops on failure: observability must
+    # never fail a decode (serve/observability.py owns the metric
+    # definitions; the engine just reports).
+
+    def _observe_step(
+        self, step_ms: float, batch: int, tokens: int
+    ) -> None:
+        try:
+            from ..serve.observability import observe_engine_step
+
+            stats = self._sched.stats()
+            observe_engine_step(
+                self._tags, step_ms, batch, tokens,
+                stats["slots_used"], stats["slots_total"],
+                stats["waiting"],
+            )
+        except Exception:
+            pass
+
+    def _observe_prefill(self, chunk_ms: float, tokens: int) -> None:
+        try:
+            from ..serve.observability import observe_engine_prefill
+
+            observe_engine_prefill(self._tags, chunk_ms, tokens)
+        except Exception:
+            pass
+
+    def _observe_ttft(self, ttft_ms: float) -> None:
+        try:
+            from ..serve.observability import observe_engine_ttft
+
+            observe_engine_ttft(self._tags, ttft_ms)
+        except Exception:
+            pass
+
+    def _observe_finish(self, reason: str) -> None:
+        try:
+            from ..serve.observability import observe_engine_finish
+
+            observe_engine_finish(self._tags, reason)
+        except Exception:
+            pass
+
+    def _observe_occupancy(self) -> None:
+        try:
+            from ..serve.observability import (
+                observe_engine_occupancy,
+            )
+
+            stats = self._sched.stats()
+            observe_engine_occupancy(
+                self._tags, stats["slots_used"],
+                stats["slots_total"], stats["waiting"],
+            )
+        except Exception:
+            pass

@@ -1,0 +1,133 @@
+"""Slot-arranged KV cache for the continuous-batching engine.
+
+Layout: one shared cache per engine, shaped
+
+    k, v: [layers, n_slots, kv_heads, max_len, head_dim]
+
+i.e. `models/generate.init_kv_cache` with batch == n_slots. Every
+shape is STATIC: the decode step always runs over the full slot batch
+(dead slots ride along masked by `alive`/`valid_len`), prompts pad to
+a small set of length buckets, and prefill feeds fixed-size chunks —
+so XLA compiles once per bucket and never again, the TPU-serving
+contract (ISSUE: "static shapes so XLA compiles once per bucket").
+
+Eviction is free-list bookkeeping only: a finished/cancelled slot is
+NOT zeroed. Junk KV beyond a row's `valid_len` is masked out of
+attention, and every position < valid_len is rewritten by the
+occupying request before it becomes visible (prefill overwrites
+[0, bucket); decode writes position p in the same step that extends
+valid_len past p) — so reuse is O(1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..models.generate import init_kv_cache
+
+
+def bucket_for(n: int, chunk: int, max_len: int) -> int:
+    """Smallest multiple of `chunk` holding `n` tokens (whole-chunk
+    prefill: the last chunk pads rather than shrinking, keeping the
+    chunk shape static). Raises when it exceeds the slot capacity."""
+    if n < 1:
+        raise ValueError("empty prompt")
+    bucket = ((n + chunk - 1) // chunk) * chunk
+    if bucket > max_len:
+        raise ValueError(
+            f"prompt of {n} tokens needs a {bucket}-token bucket but "
+            f"slots hold max_len={max_len}"
+        )
+    return bucket
+
+
+def _insert_slot_impl(cache_k, cache_v, new_k, new_v, slot):
+    start = (0, slot, 0, 0, 0)
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_k, new_k.astype(cache_k.dtype), start
+    )
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_v, new_v.astype(cache_v.dtype), start
+    )
+    return cache_k, cache_v
+
+
+_insert_jit = None
+
+
+def _insert_slot(cache_k, cache_v, new_k, new_v, slot):
+    """Write a prefilled [layers, 1, heads, bucket, hd] region into
+    slot `slot` at positions [0, bucket). `slot` is traced, so this
+    compiles once per bucket length, not per slot. The big cache is
+    donated on accelerator backends (in-place slot write, no
+    whole-cache copy per admission); CPU keeps copies
+    (models/generate.accel_donate)."""
+    global _insert_jit
+    if _insert_jit is None:
+        from ..models.generate import accel_donate
+
+        _insert_jit = partial(
+            jax.jit, donate_argnums=accel_donate(0, 1)
+        )(_insert_slot_impl)
+    return _insert_jit(cache_k, cache_v, new_k, new_v, slot)
+
+
+class SlotKVCache:
+    """The engine's shared KV cache plus its prompt-length buckets."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        n_slots: int,
+        max_len: int,
+        prefill_chunk: int,
+    ):
+        if prefill_chunk < 1 or prefill_chunk > max_len:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} outside [1, {max_len}]"
+            )
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len)
+        self.prefill_chunk = int(prefill_chunk)
+        self._cache = init_kv_cache(cfg, self.n_slots, self.max_len)
+
+    # -- decode-batch view --------------------------------------------
+    @property
+    def cache(self) -> Dict[str, jax.Array]:
+        """The {"k", "v", "length"} dict the shared decode step
+        consumes (models/generate._forward_with_cache layout)."""
+        return self._cache
+
+    @cache.setter
+    def cache(self, new: Dict[str, jax.Array]) -> None:
+        self._cache = new
+
+    # -- prompt buckets ------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        return bucket_for(prompt_len, self.prefill_chunk, self.max_len)
+
+    def fresh_prompt_cache(self, bucket: int) -> Dict[str, jax.Array]:
+        """A batch-1 scratch cache for one request's chunked prefill;
+        inserted into the slot batch on completion."""
+        return init_kv_cache(self.cfg, 1, bucket)
+
+    def insert(
+        self, slot: int, prompt_cache: Dict[str, jax.Array]
+    ) -> None:
+        """Adopt a completed prefill into slot `slot`."""
+        self._cache["k"], self._cache["v"] = _insert_slot(
+            self._cache["k"],
+            self._cache["v"],
+            prompt_cache["k"],
+            prompt_cache["v"],
+            jnp.int32(slot),
+        )
+
+    def nbytes(self) -> int:
+        return int(self._cache["k"].nbytes + self._cache["v"].nbytes)

@@ -55,8 +55,8 @@ def _layer_with_cache(
     sin,
     k_cache,  # [b, kv_heads, max_len, hd]
     v_cache,
-    cache_pos: jax.Array,  # [] start offset of x in the sequence
-    valid_len: jax.Array,  # [] total valid length incl. x
+    cache_pos: jax.Array,  # [b] per-row start offset of x
+    valid_len: jax.Array,  # [b] per-row valid length incl. x
 ):
     b, t, _ = x.shape
     hd = cfg.head_dim
@@ -64,12 +64,28 @@ def _layer_with_cache(
     q, k, v = project_qkv(cfg, h, layer)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, 0, cache_pos, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, 0, cache_pos, 0)
-    )
+    if cache_pos.ndim:
+        # Per-row offsets (the engine's slot batch: rows sit at
+        # different sequence positions) — vmapped update lowers to a
+        # batched scatter.
+        _update = jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (0, p, 0)
+            )
+        )
+        k_cache = _update(k_cache, k.astype(k_cache.dtype), cache_pos)
+        v_cache = _update(v_cache, v.astype(v_cache.dtype), cache_pos)
+    else:
+        # Uniform offset (generate's scan decode, whole-prompt
+        # prefill): keep the contiguous single dynamic_update_slice —
+        # a scatter here would tax the HBM-bound hot path for
+        # nothing.
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, cache_pos, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, cache_pos, 0)
+        )
     max_len = k_cache.shape[2]
     groups = cfg.n_heads // cfg.n_kv_heads
     kf = jnp.repeat(k_cache, groups, axis=1)
@@ -83,13 +99,20 @@ def _layer_with_cache(
         )
         * scale
     )
-    # Causal + cache-validity mask over absolute positions.
-    q_pos = cache_pos + jnp.arange(t)
+    # Causal + cache-validity mask over absolute positions; q_pos and
+    # valid_len each broadcast from scalar (uniform) or per-row form.
     k_pos = jnp.arange(max_len)
-    mask = (k_pos[None, :] <= q_pos[:, None]) & (
-        k_pos[None, :] < valid_len
+    if cache_pos.ndim:
+        q_pos = cache_pos[:, None] + jnp.arange(t)[None, :]  # [b, t]
+    else:
+        q_pos = (cache_pos + jnp.arange(t))[None, :]  # [1, t]
+    vl = (
+        valid_len[:, None, None] if valid_len.ndim else valid_len
     )
-    logits = jnp.where(mask[None, None], logits, -1e30)
+    mask = (k_pos[None, None, :] <= q_pos[:, :, None]) & (
+        k_pos[None, None, :] < vl
+    )  # [b or 1, t, max_len]
+    logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vf.astype(jnp.float32))
     attn = attn.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(b, t, -1)
@@ -102,9 +125,18 @@ def _layer_with_cache(
 def _forward_with_cache(
     params, cfg: LlamaConfig, tokens, cache, cache_pos, valid_len
 ):
-    """tokens [b, t] -> (logits [b, t, vocab], new cache)."""
+    """tokens [b, t] -> (logits [b, t, vocab], new cache).
+
+    `cache_pos` / `valid_len` may each (independently) be scalars
+    (whole batch at one offset, the `generate` path) or `[b]` arrays
+    (per-row offsets/lengths — the engine's slot batch, ragged
+    `generate_stream` prefill). Scalars keep the original contiguous
+    cache update; per-row offsets take the vmapped scatter."""
     b, t = tokens.shape
-    positions = cache_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
+    cache_pos = jnp.asarray(cache_pos, jnp.int32)
+    valid_len = jnp.asarray(valid_len, jnp.int32)
+    row_pos = cache_pos[:, None] if cache_pos.ndim else cache_pos
+    positions = row_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
     x = embed_tokens(cfg, params, tokens)
     cos, sin = rotary_embedding(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
@@ -137,6 +169,102 @@ def _sample(logits, key, temperature: float, top_k: int):
         cutoff = top_vals[:, -1][:, None]
         logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------
+# Shared decode kernel: `generate` (scan body), `generate_stream` and
+# the continuous-batching engine (llm/engine.py) all run THIS step —
+# one sampling implementation, one cache-update implementation. The
+# jitted wrappers are the per-step dispatch entry points; `generate`
+# inlines `_decode_step` inside its own jit/scan.
+# ---------------------------------------------------------------------
+
+
+def _decode_step(
+    params,
+    cfg: LlamaConfig,
+    cache,
+    last_logits,  # [b, vocab] logits of each row's last valid token
+    positions,  # [] or [b] current per-row sequence length
+    alive,  # [b] bool; dead rows feed token 0 (ignored downstream)
+    key,
+    temperature: float,
+    top_k: int,
+):
+    """Sample one token from `last_logits`, run the single-token
+    forward against the cache at `positions`, and return
+    (token [b], new cache, next last_logits [b, vocab])."""
+    token = _sample(last_logits, key, temperature, top_k)
+    token = jnp.where(alive, token, 0)
+    logits, cache = _forward_with_cache(
+        params, cfg, token[:, None], cache, positions, positions + 1
+    )
+    return token, cache, logits[:, 0]
+
+
+def accel_donate(*argnums: int):
+    """`donate_argnums` for a per-step serving jit: donate (in-place
+    update) on accelerator backends — decode is HBM-bound and the KV
+    cache must not be copied per token — but NOT on CPU, where XLA
+    donation is broken under forced host devices (same gating as
+    bench.py's donate=False CPU fallback, PR 4). Called lazily so
+    importing this module never initializes a backend."""
+    return () if jax.default_backend() == "cpu" else argnums
+
+
+_decode_step_jit = None
+
+
+def decode_step(
+    params,
+    cfg: LlamaConfig,
+    cache,
+    last_logits,
+    positions,
+    alive,
+    key,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+):
+    """Jitted single-step decode — the per-step dispatch entry point
+    shared by `generate_stream` and the engine. Compiles once per
+    (batch, cache, sampling) shape; `positions` may be per-row. On
+    accelerator backends the passed-in `cache`/`last_logits` buffers
+    are DONATED (updated in place): treat them as consumed and use
+    the returned values."""
+    global _decode_step_jit
+    if _decode_step_jit is None:
+        _decode_step_jit = partial(
+            jax.jit,
+            static_argnames=("temperature", "top_k", "cfg"),
+            donate_argnums=accel_donate(2, 3),
+        )(_decode_step)
+    return _decode_step_jit(
+        params, cfg, cache, last_logits, positions, alive, key,
+        temperature=temperature, top_k=top_k,
+    )
+
+
+_prefill_jit = None
+
+
+def prefill(params, cfg: LlamaConfig, tokens, cache, cache_pos, valid_len):
+    """Jitted KV-cache prefill: one forward over `tokens` writing the
+    cache at `cache_pos`. Shared by `generate_stream` and the engine's
+    chunked prefill (one compile per (chunk, cache) shape bucket).
+    `cache` is donated on accelerator backends — use the returned
+    cache."""
+    global _prefill_jit
+    if _prefill_jit is None:
+        _prefill_jit = partial(
+            jax.jit,
+            static_argnames=("cfg",),
+            donate_argnums=accel_donate(3),
+        )(_forward_with_cache)
+    return _prefill_jit(
+        params, cfg, tokens, cache, cache_pos, valid_len
+    )
 
 
 @partial(
@@ -188,19 +316,13 @@ def generate(
 
     def step(carry, key):
         cache, last_logits, position, alive = carry
-        token = _sample(last_logits, key, temperature, top_k)
-        token = jnp.where(alive, token, 0)
-        logits, cache = _forward_with_cache(
-            params,
-            cfg,
-            token[:, None],
-            cache,
-            position,
-            position + 1,
+        token, cache, next_logits = _decode_step(
+            params, cfg, cache, last_logits, position, alive, key,
+            temperature, top_k,
         )
         next_alive = alive & (token != eos_token)
         return (
-            (cache, logits[:, 0], position + 1, next_alive),
+            (cache, next_logits, position + 1, next_alive),
             (token, alive),
         )
 
@@ -230,6 +352,7 @@ def generate_stream(
     top_k: int = 0,
     eos_token: int = -1,
     rng: Optional[jax.Array] = None,
+    cache_len: Optional[int] = None,
 ):
     """Incremental analog of `generate`: yields one `[b]` int token
     array per decode step, as sampled — the producer side of token
@@ -237,37 +360,57 @@ def generate_stream(
     to consumers while decoding continues). Trades the scan-fused
     decode loop for per-step dispatch of a single jitted step, so
     time-to-first-token is one prefill + one step instead of the whole
-    budget. Stops early when every row has emitted `eos_token`."""
+    budget. Stops early when every row has emitted `eos_token`.
+
+    `cache_len` sets the KV cache to an EXACT fixed size so a serving
+    caller compiles once per prompt bucket instead of once per
+    (bucket, budget) pair (extra positions stay masked). It must hold
+    the padded prompt AND every row's true length + budget — decode
+    starts at per-row TRUE lengths, so a near-capacity request fits
+    whenever true_len + max_new_tokens <= cache_len even if the
+    padded bucket + budget would not."""
     import numpy as np
 
     b, prompt_len = prompt_tokens.shape
-    max_len = prompt_len + max_new_tokens
+    if cache_len is not None:
+        if cache_len != int(cache_len):
+            raise ValueError(
+                f"cache_len must be integral, got {cache_len!r}"
+            )
+        max_len = int(cache_len)
+        needed = int(np.max(np.asarray(prompt_lengths)))
+        if prompt_len > max_len or needed + max_new_tokens > max_len:
+            raise ValueError(
+                f"cache_len={max_len} cannot hold the padded prompt "
+                f"({prompt_len}) and true length ({needed}) + "
+                f"max_new_tokens ({max_new_tokens})"
+            )
+    else:
+        max_len = prompt_len + max_new_tokens
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, b, max_len)
 
-    logits, cache = _forward_with_cache(
+    # Per-row valid lengths + decode positions: rows shorter than the
+    # padded prompt start decoding at their TRUE length, so padding
+    # never enters attention (each new token overwrites the pad KV at
+    # its position before valid_len covers it) — unlike `generate`,
+    # ragged batches are EXACT here.
+    logits, cache = prefill(
         params, cfg, prompt_tokens, cache,
-        jnp.int32(0), jnp.int32(prompt_len),
+        jnp.int32(0), prompt_lengths.astype(jnp.int32),
     )
     last = jnp.take_along_axis(
         logits, (prompt_lengths - 1)[:, None, None], axis=1
     )[:, 0]
 
-    @jax.jit
-    def one_step(params, cache, last_logits, position, alive, key):
-        token = _sample(last_logits, key, temperature, top_k)
-        token = jnp.where(alive, token, 0)
-        logits, cache = _forward_with_cache(
-            params, cfg, token[:, None], cache, position, position + 1
-        )
-        return token, cache, logits[:, 0], alive & (token != eos_token)
-
     alive = jnp.ones(b, bool)
-    position = jnp.int32(prompt_len)
+    position = prompt_lengths.astype(jnp.int32)
     for key in jax.random.split(rng, max_new_tokens):
-        token, cache, last, alive = one_step(
-            params, cache, last, position, alive, key
+        token, cache, last = decode_step(
+            params, cfg, cache, last, position, alive, key,
+            temperature=temperature, top_k=top_k,
         )
+        alive = alive & (token != eos_token)
         yield np.asarray(token)  # device->host sync per step
         position = position + 1
         # Post-step mask: once every row has emitted EOS there is no

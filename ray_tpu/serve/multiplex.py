@@ -72,6 +72,13 @@ class _ModelMultiplexWrapper:
         with self._lock:
             return list(self._models)
 
+    def models(self) -> Dict[str, Any]:
+        """Snapshot of the loaded {model_id: model} set (stable public
+        accessor — e.g. LLMServer.engine_stats reads per-family engine
+        health through it)."""
+        with self._lock:
+            return dict(self._models)
+
     def load(self, model_id: str) -> Any:
         while True:
             with self._lock:

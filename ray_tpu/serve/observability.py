@@ -58,6 +58,10 @@ __all__ = [
     "observe_handler",
     "observe_model_load",
     "replica_executing",
+    "observe_engine_step",
+    "observe_engine_prefill",
+    "observe_engine_ttft",
+    "observe_engine_finish",
     "deployment_snapshot",
 ]
 
@@ -140,7 +144,9 @@ _metrics_lock = threading.Lock()
 _metrics: Dict[str, object] = {}
 
 
-def _histogram(name: str, description: str, tag_keys: tuple):
+def _histogram(
+    name: str, description: str, tag_keys: tuple, boundaries=None
+):
     from ..util.metrics import Histogram
 
     with _metrics_lock:
@@ -149,7 +155,7 @@ def _histogram(name: str, description: str, tag_keys: tuple):
             metric = _metrics[name] = Histogram(
                 name,
                 description=description,
-                boundaries=LATENCY_BUCKETS_MS,
+                boundaries=boundaries or LATENCY_BUCKETS_MS,
                 tag_keys=tag_keys,
             )
     return metric
@@ -373,6 +379,164 @@ def replica_executing(
 
 
 # ---------------------------------------------------------------------
+# continuous-batching engine (ray_tpu/llm): per-iteration decode and
+# prefill timing, slot occupancy, token throughput. Tagged by model
+# FAMILY on top of app/deployment — one engine per multiplexed family,
+# so family series are the per-family slot accounting. Names ride the
+# normal metrics pipe: labeled series on /metrics, folded per
+# deployment into /api/serve by deployment_snapshot below.
+# ---------------------------------------------------------------------
+
+ENGINE_TAGS = ("app", "deployment", "family")
+
+#: Decode-batch-size bucket boundaries (requests per step).
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def _engine_histogram(name: str, description: str, boundaries=None):
+    return _histogram(
+        name, description, ENGINE_TAGS, boundaries=boundaries
+    )
+
+
+def observe_engine_step(
+    tags: Dict[str, str],
+    step_ms: float,
+    batch: int,
+    tokens: int,
+    slots_used: int,
+    slots_total: int,
+    waiting: int,
+) -> None:
+    """Engine: one decode iteration over the slot batch."""
+    if not _ENABLED:
+        return
+    try:
+        _engine_histogram(
+            "serve_engine_decode_step_ms",
+            "One decode step over the engine's slot batch",
+        ).observe(step_ms, tags=tags)
+        _engine_histogram(
+            "serve_engine_step_batch",
+            "Sequences decoded per engine step (batch size)",
+            boundaries=BATCH_BUCKETS,
+        ).observe(float(batch), tags=tags)
+        if tokens:
+            _counter(
+                "serve_engine_tokens_total",
+                "Tokens sampled by the engine's decode loop",
+                ENGINE_TAGS,
+            ).inc(float(tokens), tags=tags)
+        _engine_gauges(tags, slots_used, slots_total, waiting)
+    except Exception:
+        pass
+
+
+def observe_engine_prefill(
+    tags: Dict[str, str], chunk_ms: float, tokens: int
+) -> None:
+    """Engine: one prefill chunk (interleaved with decode steps)."""
+    if not _ENABLED:
+        return
+    try:
+        _engine_histogram(
+            "serve_engine_prefill_chunk_ms",
+            "One prefill chunk forward in the engine",
+        ).observe(chunk_ms, tags=tags)
+        _counter(
+            "serve_engine_prefill_tokens_total",
+            "Prompt tokens prefilled by the engine",
+            ENGINE_TAGS,
+        ).inc(float(tokens), tags=tags)
+    except Exception:
+        pass
+
+
+def observe_engine_ttft(tags: Dict[str, str], ttft_ms: float) -> None:
+    """Engine: submit -> first sampled token for one request."""
+    if not _ENABLED:
+        return
+    try:
+        _engine_histogram(
+            "serve_engine_ttft_ms",
+            "Engine-side time to first token per request",
+        ).observe(ttft_ms, tags=tags)
+    except Exception:
+        pass
+
+
+def observe_engine_finish(tags: Dict[str, str], reason: str) -> None:
+    """Engine: one request retired (stop/length/cancelled)."""
+    if not _ENABLED:
+        return
+    try:
+        _counter(
+            "serve_engine_requests_total",
+            "Requests retired by the engine, by outcome",
+            ENGINE_TAGS + ("outcome",),
+        ).inc(1.0, tags={**tags, "outcome": reason})
+    except Exception:
+        pass
+
+
+def observe_engine_occupancy(
+    tags: Dict[str, str],
+    slots_used: int,
+    slots_total: int,
+    waiting: int,
+) -> None:
+    """Engine: occupancy push OUTSIDE the decode step — cancellation,
+    request retirement, and engine unload all free slots without a
+    following step, and the gauges must not report phantom occupancy
+    until the next request arrives."""
+    if not _ENABLED:
+        return
+    try:
+        _engine_gauges(tags, slots_used, slots_total, waiting)
+    except Exception:
+        pass
+
+
+def _engine_gauges(
+    tags: Dict[str, str],
+    slots_used: int,
+    slots_total: int,
+    waiting: int,
+) -> None:
+    """Slot-occupancy gauges, throttled like replica_executing:
+    zero-crossing edges always push, same-sign updates at most one
+    per period per engine."""
+    key = ("engine", tags.get("app", ""), tags.get("deployment", ""),
+           tags.get("family", ""))
+    now = time.monotonic()
+    last_ts, last_value = _gauge_last.get(key, (0.0, -1))
+    edge = (slots_used == 0) != (last_value == 0)
+    if not edge and now - last_ts < _GAUGE_MIN_INTERVAL_S:
+        return
+    _gauge_last[key] = (now, slots_used)
+    for name, desc, value in (
+        (
+            "serve_engine_slots_used",
+            "KV slots occupied by decoding sequences",
+            slots_used,
+        ),
+        (
+            "serve_engine_slots_total",
+            "KV slots provisioned in the engine",
+            slots_total,
+        ),
+        (
+            "serve_engine_waiting",
+            "Requests queued for a free engine slot",
+            waiting,
+        ),
+    ):
+        _gauge(name, desc, ENGINE_TAGS).set(
+            float(value), tags=tags
+        )
+
+
+# ---------------------------------------------------------------------
 # read side: fold the head's metric table into per-deployment rows
 # ---------------------------------------------------------------------
 
@@ -450,4 +614,86 @@ def deployment_snapshot(summary: Dict[str, dict]) -> Dict[tuple, dict]:
             continue
         target["model_loads"] = series.get("count", 0)
         target["model_load_p50_ms"] = series.get("p50", 0.0)
+
+    _fold_engine(summary, row, out)
     return out
+
+
+def _fold_engine(summary: Dict[str, dict], row, out) -> None:
+    """Continuous-batching engine series -> per-deployment rows: a
+    nested per-family breakdown plus summed top-level occupancy (the
+    at-a-glance numbers `/api/serve` and `serve.status()` show)."""
+
+    def family_row(tags: Dict[str, str]) -> Optional[dict]:
+        target = row(tags)
+        if target is None:
+            return None
+        families = target.setdefault("engine", {})
+        return families.setdefault(tags.get("family", "default"), {})
+
+    def fold(metric: str, fn) -> None:
+        for flat, series in (
+            summary.get(metric, {}).get("by_tags") or {}
+        ).items():
+            tags = _tag_dict(flat)
+            target = family_row(tags)
+            if target is not None:
+                fn(target, series)
+
+    fold(
+        "serve_engine_slots_used",
+        lambda t, s: t.__setitem__(
+            "slots_used", float(s.get("value", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_slots_total",
+        lambda t, s: t.__setitem__(
+            "slots_total", float(s.get("value", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_waiting",
+        lambda t, s: t.__setitem__(
+            "waiting", float(s.get("value", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_tokens_total",
+        lambda t, s: t.__setitem__(
+            "tokens_total", float(s.get("total", 0.0) or 0.0)
+        ),
+    )
+
+    def histo(target: dict, series: dict, prefix: str) -> None:
+        if not series.get("count"):
+            return
+        target[f"{prefix}_p50"] = series.get("p50", 0.0)
+        if "p99" in series:
+            target[f"{prefix}_p99"] = series["p99"]
+
+    fold(
+        "serve_engine_step_batch",
+        lambda t, s: histo(t, s, "batch"),
+    )
+    fold(
+        "serve_engine_decode_step_ms",
+        lambda t, s: histo(t, s, "decode_ms"),
+    )
+    fold(
+        "serve_engine_ttft_ms",
+        lambda t, s: histo(t, s, "ttft_ms"),
+    )
+
+    # Summed top-level occupancy per deployment (families collapse
+    # into the at-a-glance columns).
+    for target in out.values():
+        families = target.get("engine")
+        if not families:
+            continue
+        for key in (
+            "slots_used", "slots_total", "waiting", "tokens_total",
+        ):
+            target[f"engine_{key}"] = sum(
+                f.get(key, 0.0) for f in families.values()
+            )

@@ -237,6 +237,24 @@ class Replica:
             reset_request_context(ctx_token)
             _model_id_ctx.reset(token)
 
+    def cancel_stream(self, request_id: str) -> bool:
+        """Best-effort cancel of an in-flight streaming request: the
+        consumer abandoned the stream (client disconnect,
+        DeploymentResponseGenerator.close()), so a user callable that
+        can stop producing should (the LLM engine frees the request's
+        KV slot mid-decode). Instances opt in by implementing
+        ``__serve_cancel_stream__(request_id) -> bool``; without the
+        hook the stream simply runs to completion as before."""
+        hook = getattr(
+            self._instance, "__serve_cancel_stream__", None
+        )
+        if not callable(hook):
+            return False
+        try:
+            return bool(hook(request_id))
+        except Exception:
+            return False
+
     def node_id(self) -> str:
         """This replica's node (routers prefer local replicas)."""
         import ray_tpu as rt

@@ -79,13 +79,28 @@ class DeploymentResponseGenerator:
     handle.py DeploymentResponseGenerator). Chunks arrive as the
     replica produces them — the transport is the runtime's streaming
     generator path, so a slow consumer doesn't buffer the whole
-    response anywhere."""
+    response anywhere, and each stream is consumed independently: one
+    stream blocking on its next chunk must never head-of-line block a
+    sibling stream from the same (batched) replica — the
+    continuous-batching engine serves many interleaved token streams
+    from one replica (regression: test_serve.py
+    test_interleaved_streams_not_serialized)."""
 
-    def __init__(self, ref_gen, router: "DeploymentHandle", replica_id):
+    def __init__(
+        self,
+        ref_gen,
+        router: "DeploymentHandle",
+        replica_id,
+        actor=None,
+        request_id: str = "",
+    ):
         self._gen = ref_gen
         self._router = router
         self._replica_id = replica_id
+        self._actor = actor
+        self._request_id = request_id
         self._finished = False
+        self._exhausted = False
 
     def __iter__(self):
         return self
@@ -98,18 +113,38 @@ class DeploymentResponseGenerator:
         try:
             ref = next(self._gen)
             return rt.get(ref, timeout=60)
+        except StopIteration:
+            self._exhausted = True
+            self.close()
+            raise
         except BaseException:
             self.close()
             raise
 
     def close(self) -> None:
-        """Release the ongoing-count slot exactly once. Abandoning the
-        iterator mid-stream (client disconnect, break) without close()
-        would leave phantom in-flight load skewing pow-2 routing and
-        pinning the autoscaler up forever."""
-        if not self._finished:
-            self._finished = True
-            self._router._ongoing_done(self._replica_id)
+        """Release the ongoing-count slot exactly once, and tell the
+        replica when the stream was ABANDONED (client disconnect,
+        break) rather than exhausted: a continuous-batching engine
+        frees the request's KV slot mid-decode instead of decoding
+        the rest of the token budget for nobody. Without the
+        ongoing-count release, phantom in-flight load would skew
+        pow-2 routing and pin the autoscaler up forever."""
+        if self._finished:
+            return
+        self._finished = True
+        self._router._ongoing_done(self._replica_id)
+        if (
+            not self._exhausted
+            and self._actor is not None
+            and self._request_id
+        ):
+            try:
+                ref = self._actor.cancel_stream.remote(
+                    self._request_id
+                )
+                del ref  # fire-and-forget: cancel is best-effort
+            except Exception:
+                pass
 
     def __del__(self):
         self.close()
@@ -505,7 +540,11 @@ class DeploymentHandle:
             ).remote(self._method, args, kwargs, self._model_id, ctx)
             self._ongoing_sent(replica["id"])
             return DeploymentResponseGenerator(
-                ref_gen, self, replica["id"]
+                ref_gen,
+                self,
+                replica["id"],
+                actor=replica["actor"],
+                request_id=str(ctx.get("request_id", "")),
             )
         ref = replica["actor"].handle_request.remote(
             self._method, args, kwargs, self._model_id, ctx

@@ -1,0 +1,491 @@
+"""Serving benchmark: open-loop Poisson traffic against the
+continuous-batching LLM engine (ray_tpu/llm) through the full serve
+path — HTTP proxy -> router -> replica -> engine — in the
+bench.py/scalebench.py JSON-trajectory idiom.
+
+Prints ONE JSON line on the LAST stdout line and writes the full
+result to SERVEBENCH.json:
+
+  {"metric": "servebench_tokens_per_s", "value": N, "points": [...],
+   "baseline": [...], "comparison": {...}, ...}
+
+Design:
+
+* OPEN-LOOP arrivals: a seeded exponential inter-arrival clock fires
+  requests regardless of completions (closed-loop clients hide
+  queueing collapse; open-loop is the "millions of users" shape).
+  Each request runs in its own thread: POST /llm with a token-id
+  prompt, stream the chunked response, timestamp every chunk.
+* Mixed lengths: prompt lengths and token budgets sample from a
+  short/long mix per request (seeded), exercising several prefill
+  buckets and ragged completions.
+* Multi-family points tag requests with `serve_multiplexed_model_id`
+  so the proxy/router exercise the multiplex path and BOTH families'
+  engines decode concurrently (the smoke gate asserts it).
+* The BASELINE redeploys the same app with the engine kill switch
+  off (`engine_enabled=False`): every request runs its own
+  `generate_stream()` — serialize-per-request serving — at the same
+  offered load, so the comparison isolates continuous batching.
+* Engine visibility: each point samples `/api/serve` (occupancy,
+  batch p50) while traffic runs, and the result records whether the
+  engine series render on the Prometheus exposition — the
+  observability acceptance ISSUE 10 names.
+
+Metrics per point: p50/p99 time-to-first-token, p50/p99 per-token
+latency (mean inter-token gap per request, percentiled over
+requests), aggregate tokens/s, achieved vs offered load, errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT_PATH = os.path.join(REPO, "SERVEBENCH.json")
+
+TINY_CONFIG = {
+    "vocab_size": 128, "dim": 64, "n_layers": 2, "n_heads": 4,
+    "n_kv_heads": 2, "intermediate": 128, "max_seq_len": 256,
+    "dtype": "float32",
+}
+#: Default (non-smoke) model: big enough that batched GEMMs amortize
+#: per-step dispatch, small enough to serve from one CPU test box.
+BASE_CONFIG = {
+    "vocab_size": 512, "dim": 256, "n_layers": 4, "n_heads": 8,
+    "n_kv_heads": 4, "intermediate": 512, "max_seq_len": 512,
+    "dtype": "float32",
+}
+
+
+def _percentile(values, q):
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(
+        len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1))))
+    )
+    return ordered[idx]
+
+
+class _RequestResult:
+    __slots__ = (
+        "ok", "error", "ttft_ms", "per_token_ms", "tokens",
+        "start", "end", "family",
+    )
+
+    def __init__(self):
+        self.ok = False
+        self.error = ""
+        self.ttft_ms = 0.0
+        self.per_token_ms = 0.0
+        self.tokens = 0
+        self.start = 0.0
+        self.end = 0.0
+        self.family = ""
+
+
+def _one_request(port, route, payload, family, timeout_s):
+    """POST the prompt, stream the chunked body, time every chunk."""
+    result = _RequestResult()
+    result.family = family
+    result.start = time.perf_counter()
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", port, timeout=timeout_s
+    )
+    try:
+        headers = {"Content-Type": "application/json"}
+        if family:
+            headers["serve_multiplexed_model_id"] = family
+        conn.request(
+            "POST", route, body=json.dumps(payload), headers=headers
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            result.error = f"http {resp.status}"
+            resp.read()
+            return result
+        first = None
+        arrivals = []
+        buffered = b""
+        while True:
+            data = resp.read1(65536)
+            now = time.perf_counter()
+            if not data:
+                break
+            if first is None:
+                first = now
+            buffered += data
+            arrivals.extend(
+                (now,) * (data.count(b" "))
+            )
+        result.end = time.perf_counter()
+        result.tokens = len(buffered.split())
+        if first is None or not result.tokens:
+            result.error = "empty stream"
+            return result
+        result.ttft_ms = (first - result.start) * 1e3
+        if len(arrivals) > 1:
+            result.per_token_ms = (
+                (arrivals[-1] - arrivals[0])
+                / (len(arrivals) - 1)
+                * 1e3
+            )
+        result.ok = True
+        return result
+    except Exception as e:  # noqa: BLE001 — recorded per request
+        result.error = repr(e)
+        result.end = time.perf_counter()
+        return result
+    finally:
+        conn.close()
+
+
+def _sample_engine_state(route_key):
+    """One /api/serve-equivalent snapshot of the deployment's engine
+    occupancy (serve.status_detail serves the same payload)."""
+    try:
+        import ray_tpu.serve as serve
+
+        row = serve.status_detail().get(route_key) or {}
+        families = row.get("engine") or {}
+        return {
+            "slots_used": float(row.get("engine_slots_used", 0.0)),
+            "families_active": sum(
+                1 for f in families.values()
+                if f.get("slots_used", 0.0) > 0
+            ),
+            "batch_p50": max(
+                (f.get("batch_p50", 0.0) for f in families.values()),
+                default=0.0,
+            ),
+            "families": sorted(families),
+        }
+    except Exception:
+        return {}
+
+
+def run_point(
+    *,
+    port,
+    route,
+    route_key,
+    offered_rps,
+    duration_s,
+    families,
+    prompt_mix,
+    max_new_mix,
+    seed,
+    request_timeout_s=60.0,
+):
+    """One offered-load point: Poisson arrivals for `duration_s`."""
+    rng = random.Random(seed)
+    results = []
+    results_lock = threading.Lock()
+    threads = []
+    samples = []
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.is_set():
+            sample = _sample_engine_state(route_key)
+            if sample:
+                samples.append(sample)
+            stop_sampling.wait(0.5)
+
+    sampler_thread = threading.Thread(target=sampler, daemon=True)
+    sampler_thread.start()
+
+    def fire(payload, family):
+        result = _one_request(
+            port, route, payload, family, request_timeout_s
+        )
+        with results_lock:
+            results.append(result)
+
+    t0 = time.perf_counter()
+    next_at = t0
+    while True:
+        next_at += rng.expovariate(offered_rps)
+        if next_at - t0 > duration_s:
+            break
+        delay = next_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        lo, hi = prompt_mix[rng.randrange(len(prompt_mix))]
+        prompt = [
+            rng.randrange(1, 100) for _ in range(rng.randint(lo, hi))
+        ]
+        payload = {
+            "prompt": prompt,
+            "max_new_tokens": max_new_mix[
+                rng.randrange(len(max_new_mix))
+            ],
+        }
+        family = families[rng.randrange(len(families))]
+        thread = threading.Thread(
+            target=fire, args=(payload, family), daemon=True
+        )
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join(timeout=request_timeout_s)
+    stop_sampling.set()
+    sampler_thread.join(timeout=2)
+
+    done = [r for r in results if r.ok]
+    errors = [r for r in results if not r.ok]
+    window_end = max((r.end for r in done), default=time.perf_counter())
+    wall = max(1e-9, window_end - t0)
+    total_tokens = sum(r.tokens for r in done)
+    ttfts = [r.ttft_ms for r in done]
+    per_token = [r.per_token_ms for r in done if r.per_token_ms > 0]
+    return {
+        "offered_rps": offered_rps,
+        "achieved_rps": round(len(done) / wall, 2),
+        "duration_s": duration_s,
+        "mix": sorted(set(families)),
+        "requests": len(results),
+        "completed": len(done),
+        "errors": len(errors),
+        "error_sample": errors[0].error if errors else "",
+        "tokens": total_tokens,
+        "tokens_per_s": round(total_tokens / wall, 1),
+        "ttft_ms": {
+            "p50": round(_percentile(ttfts, 0.50), 1),
+            "p99": round(_percentile(ttfts, 0.99), 1),
+        },
+        "per_token_ms": {
+            "p50": round(_percentile(per_token, 0.50), 2),
+            "p99": round(_percentile(per_token, 0.99), 2),
+        },
+        "engine": {
+            "max_slots_used": max(
+                (s["slots_used"] for s in samples), default=0.0
+            ),
+            "max_concurrent_families": max(
+                (s["families_active"] for s in samples), default=0
+            ),
+            "batch_p50": max(
+                (s["batch_p50"] for s in samples), default=0.0
+            ),
+            "families_seen": sorted(
+                {f for s in samples for f in s.get("families", [])}
+            ),
+        },
+    }
+
+
+def _deploy(families, engine_cfg, engine_enabled, version):
+    import ray_tpu.serve as serve
+    from ray_tpu.llm import build_llm_app
+
+    app = build_llm_app(
+        families,
+        engine=engine_cfg,
+        engine_enabled=engine_enabled,
+        max_ongoing_requests=max(16, engine_cfg.get("slots", 4) * 4),
+    )
+    # Version forces a replica replacement on redeploy (engine -> a
+    # fresh baseline replica, not a warm reuse).
+    app.deployment.version = version
+    return serve.run(app, name="llm", route_prefix="/llm")
+
+
+def _warm(port, families, prompt_mix, max_new_mix):
+    """One request per family per prompt-length BUCKET EDGE so every
+    jit compile (prefill bucket, slot insert, decode step) lands
+    outside the measured windows. Token budgets don't add shapes
+    (the engine's slot cache and the fallback's `cache_len` are both
+    fixed), so a 2-token budget keeps warmup fast."""
+    del max_new_mix
+    for family in families:
+        for edge in sorted({n for pair in prompt_mix for n in pair}):
+            result = _one_request(
+                port,
+                "/llm",
+                {
+                    "prompt": list(range(1, edge + 1)),
+                    "max_new_tokens": 2,
+                },
+                family,
+                timeout_s=600.0,
+            )
+            if not result.ok:
+                raise RuntimeError(
+                    f"warmup failed for {family}: {result.error}"
+                )
+
+
+def run_bench(args) -> dict:
+    import ray_tpu as rt
+    import ray_tpu.serve as serve
+
+    t_start = time.perf_counter()
+    smoke = args.smoke
+    model = dict(TINY_CONFIG if smoke else BASE_CONFIG)
+    engine_cfg = {
+        "slots": 4 if smoke else 8,
+        "max_len": 96 if smoke else 192,
+        "prefill_chunk": 8 if smoke else 16,
+        "max_new_tokens": 64,
+    }
+    families = {
+        "tiny-a": {"kind": "init", "seed": 0, "config": model},
+        "tiny-b": {"kind": "init", "seed": 1, "config": model},
+    }
+    prompt_mix = ((4, 8), (12, 16)) if smoke else ((8, 16), (24, 48))
+    max_new_mix = (8, 16) if smoke else (16, 32)
+    # The top load must OVERSUBSCRIBE a single decode stream (arrival
+    # rate x per-request service time > 1) or continuous batching has
+    # nothing to batch — the measured smoke points sit above the
+    # serialize-per-request capacity and below the engine's.
+    loads = args.loads or ((8.0, 24.0) if smoke else (6.0, 14.0))
+    duration = args.duration or (8.0 if smoke else 16.0)
+
+    rt.init()
+    port = serve.start(http_port=0, per_node=False)
+    route_key = "llm/llm"
+    result = {
+        "metric": "servebench_tokens_per_s",
+        "unit": "tokens/s",
+        "smoke": bool(smoke),
+        "model": model,
+        "engine_config": engine_cfg,
+        "loads_rps": list(loads),
+        "duration_s": duration,
+        "points": [],
+        "baseline": [],
+    }
+    try:
+        _deploy(families, engine_cfg, True, "engine-1")
+        _warm(port, list(families), prompt_mix, max_new_mix)
+        for i, load in enumerate(loads):
+            # First point: single family. Later points: the full
+            # multi-family mix (the multiplex-under-load case).
+            mix = (
+                ["tiny-a"] if i == 0 else list(families)
+            )
+            result["points"].append(
+                run_point(
+                    port=port,
+                    route="/llm",
+                    route_key=route_key,
+                    offered_rps=load,
+                    duration_s=duration,
+                    families=mix,
+                    prompt_mix=prompt_mix,
+                    max_new_mix=max_new_mix,
+                    seed=100 + i,
+                )
+            )
+
+        # Engine series visible on the Prometheus exposition?
+        try:
+            from ray_tpu.util.metrics import metrics_summary
+            from ray_tpu.util.prometheus import render_prometheus
+
+            text = render_prometheus(metrics_summary())
+            result["metrics_visible"] = {
+                "prometheus_engine_series": (
+                    "serve_engine_slots_used{" in text
+                    and "serve_engine_step_batch_bucket{" in text
+                ),
+                "api_serve_engine": bool(
+                    (
+                        serve.status_detail()
+                        .get(route_key, {})
+                        .get("engine")
+                    )
+                ),
+            }
+        except Exception as e:  # noqa: BLE001 — recorded
+            result["metrics_visible"] = {"error": repr(e)}
+
+        if not args.no_baseline:
+            # Same app, kill switch OFF: per-request generate_stream,
+            # measured at the same top offered load + mix.
+            _deploy(families, engine_cfg, False, "baseline-1")
+            _warm(port, list(families), prompt_mix, max_new_mix)
+            for i, load in enumerate(loads):
+                mix = ["tiny-a"] if i == 0 else list(families)
+                result["baseline"].append(
+                    run_point(
+                        port=port,
+                        route="/llm",
+                        route_key=route_key,
+                        offered_rps=load,
+                        duration_s=duration,
+                        families=mix,
+                        prompt_mix=prompt_mix,
+                        max_new_mix=max_new_mix,
+                        seed=100 + i,  # same arrival/length sequence
+                    )
+                )
+            top = result["points"][-1]
+            base = result["baseline"][-1]
+            result["comparison"] = {
+                "offered_rps": top["offered_rps"],
+                "engine_tokens_per_s": top["tokens_per_s"],
+                "baseline_tokens_per_s": base["tokens_per_s"],
+                "speedup": round(
+                    top["tokens_per_s"]
+                    / max(1e-9, base["tokens_per_s"]),
+                    2,
+                ),
+                "engine_ttft_p99_ms": top["ttft_ms"]["p99"],
+                "baseline_ttft_p99_ms": base["ttft_ms"]["p99"],
+            }
+        result["value"] = result["points"][-1]["tokens_per_s"]
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+    result["wall_s"] = round(time.perf_counter() - t_start, 1)
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="tiny model + short windows: the whole serve path on "
+        "CPU in about a minute (CI-gated by "
+        "tests/test_servebench_smoke.py)",
+    )
+    parser.add_argument(
+        "--loads", type=lambda s: [float(x) for x in s.split(",")],
+        default=None, help="offered-load points, req/s (e.g. 4,12)",
+    )
+    parser.add_argument(
+        "--duration", type=float, default=None,
+        help="seconds per load point",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="skip the engine-off comparison pass",
+    )
+    parser.add_argument(
+        "--out", default=OUT_PATH,
+        help="result JSON path (default SERVEBENCH.json)",
+    )
+    args = parser.parse_args()
+    result = run_bench(args)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -317,6 +317,90 @@ def test_streaming_handle_and_http(serve_session):
     assert first_at < 0.60, f"first HTTP chunk too late: {first_at}"
 
 
+def test_interleaved_streams_not_serialized(serve_session):
+    """Two token streams from ONE replica must progress concurrently
+    — neither may head-of-line block the other in _stream_response /
+    DeploymentResponseGenerator (ISSUE 10 satellite: a batched
+    continuous-batching replica serves many interleaved streams; if
+    stream B's chunks only arrive after stream A finishes, batching
+    is dead on arrival)."""
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Paced:
+        def __call__(self, request):
+            for i in range(6):
+                time.sleep(0.2)
+                yield f"t{i} "
+
+    handle = serve.run(Paced.bind(), name="pair", route_prefix=None)
+    gen_a = handle.options(stream=True).remote(None)
+    gen_b = handle.options(stream=True).remote(None)
+    events = []
+
+    def consume(tag, gen):
+        for _chunk in gen:
+            events.append((tag, time.time()))
+
+    threads = [
+        threading.Thread(target=consume, args=("a", gen_a)),
+        threading.Thread(target=consume, args=("b", gen_b)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    a_times = [ts for tag, ts in events if tag == "a"]
+    b_times = [ts for tag, ts in events if tag == "b"]
+    assert len(a_times) == 6 and len(b_times) == 6
+    # Interleaved, not serialized: each stream starts before the
+    # other finishes.
+    assert b_times[0] < a_times[-1], "stream b waited for stream a"
+    assert a_times[0] < b_times[-1], "stream a waited for stream b"
+
+
+def test_abandoned_stream_cancels_replica_side(serve_session):
+    """Closing a DeploymentResponseGenerator mid-stream propagates a
+    best-effort cancel to the replica (Replica.cancel_stream ->
+    __serve_cancel_stream__), so producers that can stop do — the
+    LLM engine frees the request's KV slot instead of decoding the
+    whole budget for nobody."""
+    rt, serve = serve_session
+
+    @serve.deployment
+    class Cancellable:
+        def __init__(self):
+            self.cancelled = []
+
+        def __serve_cancel_stream__(self, request_id):
+            self.cancelled.append(request_id)
+            return True
+
+        def seen_cancels(self):
+            return list(self.cancelled)
+
+        def __call__(self, request):
+            from ray_tpu.serve.observability import get_request_id
+
+            rid = get_request_id()
+            for i in range(200):
+                if rid in self.cancelled:
+                    return
+                time.sleep(0.05)
+                yield f"c{i} "
+
+    handle = serve.run(Cancellable.bind(), name="cancl", route_prefix=None)
+    gen = handle.options(stream=True).remote(None)
+    assert next(gen)  # stream is live
+    gen.close()  # abandoned mid-stream
+    deadline = time.time() + 20
+    seen = []
+    while time.time() < deadline and not seen:
+        seen = handle.seen_cancels.remote().result(timeout=30)
+        time.sleep(0.1)
+    assert seen, "cancel_stream never reached the replica"
+
+
 def test_streaming_error_truncates_chunked_body(serve_session):
     """A replica generator that raises mid-stream must NOT produce a
     well-formed chunked body: the proxy aborts the socket without the

@@ -1,0 +1,76 @@
+"""CI gate for the serving bench: `servebench.py --smoke` must run
+the FULL engine path — proxy -> router -> replica -> continuous-
+batching engine, plus the engine-off baseline — on CPU in about a
+minute and emit one well-formed JSON line (same pattern as
+test_bench_smoke.py: a broken bench is caught by the suite, not at
+measurement time)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# slow: ~90s of serving + jit compiles on a loaded 1-core CI box.
+@pytest.mark.slow
+@pytest.mark.timeout(560)
+def test_servebench_smoke_emits_composite_json(tmp_path):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out_path = str(tmp_path / "SERVEBENCH.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "servebench.py"),
+            "--smoke",
+            "--out",
+            out_path,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [
+        ln for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    with open(out_path) as f:
+        assert json.load(f) == out  # file matches the stdout line
+
+    assert out["smoke"] is True
+    assert out["metric"] == "servebench_tokens_per_s"
+
+    # >= 2 offered-load points, each with the committed percentiles.
+    assert len(out["points"]) >= 2
+    for point in out["points"]:
+        assert point["completed"] > 0
+        assert point["tokens_per_s"] > 0
+        for stat in ("p50", "p99"):
+            assert point["ttft_ms"][stat] > 0
+            assert point["per_token_ms"][stat] > 0
+
+    # The top point runs the multi-family mix and the engines served
+    # it CONCURRENTLY (occupancy sampled live from /api/serve).
+    top = out["points"][-1]
+    assert sorted(top["mix"]) == ["tiny-a", "tiny-b"]
+    assert top["engine"]["max_slots_used"] >= 2
+    assert top["engine"]["max_concurrent_families"] == 2
+
+    # Engine series visible on Prometheus + /api/serve.
+    assert out["metrics_visible"]["prometheus_engine_series"] is True
+    assert out["metrics_visible"]["api_serve_engine"] is True
+
+    # The serialize-per-request baseline ran at the same loads and
+    # continuous batching won on tokens/s at the top load.
+    assert len(out["baseline"]) == len(out["points"])
+    cmp = out["comparison"]
+    assert cmp["engine_tokens_per_s"] > cmp["baseline_tokens_per_s"]
+    assert cmp["speedup"] > 1.0
