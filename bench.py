@@ -746,6 +746,23 @@ def run_micro() -> dict:
     import ray_tpu as rt
 
     results: dict = {}
+
+    # 0. paged-KV block allocator: alloc/free cycle rate (ISSUE 11).
+    # Pure host-side bookkeeping on the serving engine's admission/
+    # retirement hot path — no cluster, measured before init so no
+    # runtime thread pollutes it. One op = reserve + release of an
+    # 8-block request against a 4096-block pool (the shape of one
+    # chat-request lifetime); a regression here taxes every engine
+    # admission.
+    from ray_tpu.llm.kv_slots import BlockAllocator
+
+    kv_alloc = BlockAllocator(4096)
+
+    def _kv_cycle():
+        kv_alloc.release(kv_alloc.reserve(8))
+
+    results["kv_block_alloc_per_s"] = _micro_case(_kv_cycle, 2000)
+
     # 8 CPUs: the suite holds up to 6 live actors (1 latency counter,
     # 4 n:n actors, 1 DAG echo) plus task workers.
     rt.init(num_cpus=8)

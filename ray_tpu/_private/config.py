@@ -184,6 +184,30 @@ class Config:
     #: deployments fall back to per-request `generate_stream()` — the
     #: serialize-per-request baseline servebench.py compares against.
     serve_engine_enabled: bool = True
+    #: Kill switch for paged-KV prefix caching (ray_tpu/llm/kv_slots):
+    #: RT_serve_prefix_cache_enabled=0 makes every `build_llm_app`
+    #: engine prefill every prompt from scratch (blocks stay private,
+    #: nothing registers in the prefix table). Resolved driver-side by
+    #: build_llm_app, like serve_engine_enabled.
+    serve_prefix_cache_enabled: bool = True
+    #: Serve request routing policy (serve/router.py):
+    #: "least_tokens" routes each request to the candidate replica
+    #: with the fewest estimated outstanding tokens (prompt + token
+    #: budget, decremented as chunks stream back); "pow2" restores the
+    #: PR-era power-of-two-choices on in-flight request counts.
+    serve_routing_policy: str = "least_tokens"
+    #: SLO admission control (kill switch
+    #: RT_serve_slo_admission_enabled=0): when even the LEAST-loaded
+    #: candidate replica's estimated outstanding tokens exceed
+    #: serve_slo_queue_threshold_tokens, the router raises
+    #: DeploymentOverloaded and the proxy sheds the request with
+    #: 503 + Retry-After instead of queueing it into TTFT collapse.
+    serve_slo_admission_enabled: bool = True
+    #: Outstanding-token threshold per replica for SLO shedding — an
+    #: estimate of the replica's engine queue depth in tokens (at the
+    #: full-path token rate this bounds worst-case time-to-first-token
+    #: for admitted requests).
+    serve_slo_queue_threshold_tokens: int = 1024
 
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`

@@ -1,10 +1,11 @@
 """LLM inference serving: continuous-batching engine + serve glue.
 
-The engine (engine.py) owns a slot-arranged KV cache (kv_slots.py)
-fed by a FIFO slot scheduler (scheduler.py); serving.py wires it
+The engine (engine.py) owns a PAGED KV cache — a refcounted block
+pool with prefix reuse (kv_slots.py) — fed by a FIFO slot scheduler
+gated on block availability (scheduler.py); serving.py wires it
 behind `ray_tpu.serve` as a multiplexed streaming deployment, and
 `servebench.py` at the repo root drives it with open-loop Poisson
-traffic (results in SERVEBENCH.json).
+traffic, single- and multi-replica (results in SERVEBENCH.json).
 """
 
 from .engine import (
@@ -15,7 +16,7 @@ from .engine import (
     TokenStream,
 )
 from .scheduler import SlotScheduler
-from .kv_slots import SlotKVCache
+from .kv_slots import BlockAllocator, BlocksExhausted, PagedKVCache
 from .serving import LLMServer, build_llm_app
 
 __all__ = [
@@ -25,7 +26,9 @@ __all__ = [
     "InferenceEngine",
     "TokenStream",
     "SlotScheduler",
-    "SlotKVCache",
+    "BlockAllocator",
+    "BlocksExhausted",
+    "PagedKVCache",
     "LLMServer",
     "build_llm_app",
 ]

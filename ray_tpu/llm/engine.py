@@ -1,36 +1,38 @@
-"""Continuous-batching LLM inference engine.
+"""Continuous-batching LLM inference engine over a PAGED KV cache.
 
-The missing middle of the serving story (ROADMAP item 1): today each
-request runs `models/generate.generate()` alone, so concurrent
-requests serialize and decode occupancy collapses. This engine owns
-ONE shared KV cache arranged as fixed-shape slots (kv_slots.py) and
-runs a background step loop that, every iteration:
+PR 10 built the batching loop on fixed slot arenas; this engine keeps
+the loop and swaps the memory system (ISSUE 11 tentpole): requests
+now hold refcounted `block_len`-sized pages of ONE shared pool
+(kv_slots.PagedKVCache) instead of each reserving a max_len arena
+row, so long-context and short-chat requests share memory, and a
+request whose prompt prefix is already pooled (same system prompt)
+SKIPS prefill for the covered blocks entirely. The background step
+loop, every iteration:
 
-  1. reaps cancellations and frees their slots immediately;
-  2. admits the FIFO head of the waiting queue into a free slot and
-     advances its prefill by ONE fixed-size chunk (Sarathi-style:
-     prefill chunks interleave with the running decode batch instead
-     of stalling it for a whole long prompt);
-  3. runs ONE jitted decode step over the FULL slot batch (static
-     shape; dead slots ride along masked) — the same
-     `models/generate.decode_step` that `generate`/`generate_stream`
-     use — and streams each live row's sampled token to its request's
-     consumer queue;
-  4. retires rows that hit EOS / their token budget, freeing slots in
-     the same iteration.
+  1. reaps cancellations and frees their slots + blocks immediately;
+  2. admits the FIFO head of the waiting queue — gated on KV-block
+     availability (not enough blocks: the head WAITS, no skip-ahead,
+     no crash) — pinning any prefix-cache hit and reserving the rest
+     of its pages, then advances its prefill by ONE fixed-size chunk
+     written straight into its pages (Sarathi-style interleave, now
+     starting AFTER the shared prefix);
+  3. runs ONE jitted paged decode step over the FULL slot batch
+     (static shapes: full-width block tables, dead rows masked and
+     parked on the null block) — `models/generate.paged_decode_step`,
+     buffer-donated on accelerator backends — streaming each live
+     row's token to its consumer queue;
+  4. retires EOS/budget rows, releasing slots and unpinning blocks in
+     the same iteration (full prompt blocks stay cached for future
+     prefix hits until memory pressure evicts them).
 
-Requests are host-side objects; per-request state on device is one
-row of the slot cache + one row of `last_logits`. Sampling parameters
-(temperature/top_k) are engine-level statics — they are jit statics
-in the shared kernel, and per-request values would force per-row
-sampling programs (documented trade; greedy is the serving default).
+Requests are host-side objects; per-request device state is the pages
+its table points at + one row of `last_logits`. Sampling parameters
+stay engine-level statics (jit statics in the shared kernel; greedy
+is the serving default).
 
-Threading: submit()/cancel() may be called from any thread (serve
-replicas run handlers on a pool); all scheduler/request state is
-guarded by one lock, JAX work runs outside it. One engine = one step
-thread = one model family — a multiplexed deployment holds several
-engines, so loading family B never blocks family A's loop
-(tests/test_llm_engine.py proves it).
+Threading: submit()/cancel() may be called from any thread; all
+scheduler/allocator/request state is guarded by one lock, JAX work
+runs outside it. One engine = one step thread = one model family.
 
 Failure: if the step loop dies, every in-flight and queued request is
 failed with the loop's exception (consumers raise, never hang) and
@@ -48,7 +50,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
-from .kv_slots import SlotKVCache
+from .kv_slots import NULL_BLOCK, PagedKVCache, default_block_len
 from .scheduler import EngineDead, EngineOverloaded, SlotScheduler
 
 __all__ = [
@@ -62,16 +64,33 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
-    """Engine admission/cache knobs (README "LLM serving engine")."""
+    """Engine admission/cache knobs (README "Paged KV & prefix
+    caching")."""
 
-    #: Decode-batch width = max concurrently-decoding sequences.
+    #: Decode-batch width = max concurrently-decoding sequences. With
+    #: the paged cache this is DECOUPLED from KV memory: extra slots
+    #: cost one block-table row + one logits row, not max_len of KV.
     slots: int = 4
-    #: Per-slot KV capacity; prompt_len + max_new_tokens must fit.
+    #: Per-REQUEST KV cap; prompt_len + max_new_tokens must fit. No
+    #: longer a per-slot memory reservation — just the admission bound
+    #: and the logical block-table width.
     max_len: int = 256
     #: Prefill chunk length. Prompts pad up to a multiple of this
     #: (the length-bucket set), and long prompts prefill chunk-by-
     #: chunk interleaved with decode steps.
     prefill_chunk: int = 32
+    #: KV block (page) length in tokens; 0 = auto (largest divisor of
+    #: prefill_chunk up to 16). Must divide prefill_chunk and max_len.
+    kv_block_len: int = 0
+    #: Physical KV pool size in blocks (one extra is reserved as the
+    #: null block); 0 = auto: slots x max_len worth — the same memory
+    #: the PR 10 arenas held, now shared on demand.
+    kv_blocks: int = 0
+    #: Prefix caching: full prompt blocks register under their exact
+    #: token prefix; a later request with the same prefix pins the
+    #: blocks and skips prefill for them. Kill switch (also
+    #: RT_serve_prefix_cache_enabled via build_llm_app).
+    prefix_cache: bool = True
     #: Waiting-queue bound; past it submit() raises EngineOverloaded.
     #: Size it so worst-case queue wait stays under the serve layer's
     #: 60 s per-chunk stream timeout (≈ max_waiting x max_new_tokens
@@ -95,8 +114,9 @@ class _Request:
     __slots__ = (
         "request_id", "prompt", "max_new_tokens", "eos_token",
         "out", "cancelled", "submitted_ts", "first_token_ts",
-        "emitted", "slot", "bucket", "prompt_cache", "offset",
-        "padded",
+        "emitted", "slot", "bucket", "offset", "padded",
+        "prefix_keys", "total_blocks", "block_ids", "n_shared",
+        "skip",
     )
 
     def __init__(
@@ -122,9 +142,14 @@ class _Request:
         # prefill progress (engine thread only)
         self.slot: Optional[int] = None
         self.bucket = 0
-        self.prompt_cache = None
         self.offset = 0
         self.padded = None
+        # paged-cache bookkeeping
+        self.prefix_keys: List[tuple] = []
+        self.total_blocks = 0
+        self.block_ids: List[int] = []
+        self.n_shared = 0
+        self.skip = 0
 
 
 class TokenStream:
@@ -197,19 +222,28 @@ class InferenceEngine:
             "app": app, "deployment": deployment,
             "family": family or "default",
         }
-        self._kv = SlotKVCache(
-            cfg, ec.slots, ec.max_len, ec.prefill_chunk
+        block_len = ec.kv_block_len or default_block_len(
+            ec.prefill_chunk
+        )
+        n_blocks = ec.kv_blocks or (
+            ec.slots * (ec.max_len // block_len) + 1
+        )
+        self._kv = PagedKVCache(
+            cfg, n_blocks, block_len, ec.max_len, ec.prefill_chunk
         )
         self._sched = SlotScheduler(ec.slots, ec.max_waiting)
         self._lock = threading.Lock()
         self._wake = threading.Event()
-        # Per-slot decode state. positions/alive live host-side (the
-        # engine mutates them per admission/step); last_logits stays
-        # on device.
+        # Per-slot decode state. positions/alive/tables live host-side
+        # (the engine mutates them per admission/step); last_logits
+        # stays on device.
         import jax.numpy as jnp
 
         self._positions = np.zeros(ec.slots, np.int32)
         self._alive = np.zeros(ec.slots, bool)
+        self._tables = np.full(
+            (ec.slots, self._kv.max_blocks), NULL_BLOCK, np.int32
+        )
         self._last_logits = jnp.zeros(
             (ec.slots, cfg.vocab_size), jnp.float32
         )
@@ -219,6 +253,9 @@ class InferenceEngine:
         self._steps = 0
         self._tokens_emitted = 0
         self._requests_done = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_tokens_saved = 0
         self._dead: Optional[BaseException] = None
         self._stopping = False
         self._thread = threading.Thread(
@@ -249,11 +286,21 @@ class InferenceEngine:
         if len(prompt) + max_new > ec.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
-                f"exceeds slot capacity max_len={ec.max_len}"
+                f"exceeds per-request capacity max_len={ec.max_len}"
             )
         if eos_token is not None and eos_token != int(eos_token):
             raise ValueError(
                 f"eos_token must be integral, got {eos_token!r}"
+            )
+        total_blocks = self._kv.blocks_for(
+            max(bucket, len(prompt) + max_new)
+        )
+        if total_blocks > self._kv.alloc.capacity():
+            # OOM is a SHED, not a crash or an unserviceable queue
+            # entry: this request could never be admitted.
+            raise EngineOverloaded(
+                f"request needs {total_blocks} KV blocks but the pool "
+                f"holds {self._kv.alloc.capacity()}; shed"
             )
         req = _Request(
             request_id or uuid.uuid4().hex[:16],
@@ -262,6 +309,9 @@ class InferenceEngine:
             ec.eos_token if eos_token is None else int(eos_token),
         )
         req.bucket = bucket
+        req.total_blocks = total_blocks
+        if ec.prefix_cache:
+            req.prefix_keys = self._kv.prefix_keys(prompt)
         with self._lock:
             if self._dead is not None or self._stopping:
                 raise EngineDead(
@@ -278,9 +328,9 @@ class InferenceEngine:
 
     def cancel(self, request_id: str) -> bool:
         """Cancel a queued or in-flight request. Queued requests end
-        immediately; running ones are reaped (slot freed) at the top
-        of the next engine iteration — mid-decode, not at stream
-        end."""
+        immediately; running ones are reaped (slot + blocks freed) at
+        the top of the next engine iteration — mid-decode, not at
+        stream end."""
         with self._lock:
             req = self._by_id.get(request_id)
             if req is None:
@@ -301,7 +351,12 @@ class InferenceEngine:
                 requests_done=self._requests_done,
                 prefilling=self._prefilling is not None,
                 kv_bytes=self._kv.nbytes(),
+                kv_block_len=self._kv.block_len,
+                prefix_hits=self._prefix_hits,
+                prefix_misses=self._prefix_misses,
+                prefix_tokens_saved=self._prefix_tokens_saved,
                 dead=self._dead is not None,
+                **self._kv.alloc.stats(),
             )
         return out
 
@@ -377,6 +432,14 @@ class InferenceEngine:
     ) -> None:
         self._sched.release(slot)
         self._alive[slot] = False
+        self._tables[slot, :] = NULL_BLOCK
+        if req.block_ids:
+            # Unpin: full prompt blocks stay in the prefix cache
+            # (refcount 0, LRU-evictable); private blocks go back to
+            # the free list. block_ids cleared so no path can double-
+            # free (the allocator would raise and kill the loop).
+            self._kv.alloc.release(req.block_ids)
+            req.block_ids = []
         self._finish_locked(req, reason)
 
     def _finish_locked(self, req: _Request, reason: str) -> None:
@@ -398,54 +461,127 @@ class InferenceEngine:
             doomed = []
         doomed.extend(self._sched.drain())
         self._alive[:] = False
+        self._tables[:, :] = NULL_BLOCK
         for req in doomed:
+            if req.block_ids:
+                try:
+                    self._kv.alloc.release(req.block_ids)
+                except Exception:
+                    pass  # dying anyway; never mask the real failure
+                req.block_ids = []
             self._by_id.pop(req.request_id, None)
             req.out.put(("err", error))
         self._observe_occupancy()
 
+    # -- admission / block allocation ---------------------------------
+    def _skip_for(self, req: _Request, hit_blocks: int) -> int:
+        """Prefill tokens a prefix hit lets this request skip: capped
+        at len(prompt) - 1 (the LAST prompt token is always computed —
+        its logits seed decoding) and rounded down to a whole prefill
+        chunk (offsets stay chunk-aligned, keeping the chunk shape
+        static)."""
+        bl = self._kv.block_len
+        chunk = self._kv.prefill_chunk
+        usable = min(hit_blocks * bl, len(req.prompt) - 1)
+        return (usable // chunk) * chunk
+
+    def _gate_locked(self, req: _Request) -> bool:
+        """Admission gate: can the FIFO head get its blocks NOW? The
+        reservation needs `total - skip` fresh blocks, and pinning the
+        hit additionally consumes `cached` availability — only the
+        hit blocks that are currently refcount-0 (cached-free) leave
+        `available()` when pinned; hits already pinned by a live
+        request are free to share. A gated admission can therefore
+        never fail its reservation one line later, and sharing a
+        LIVE request's prefix genuinely relaxes admission."""
+        alloc = self._kv.alloc
+        hits = alloc.peek_prefix(req.prefix_keys)
+        skip_blocks = self._skip_for(req, hits) // self._kv.block_len
+        cached = alloc.peek_cached(req.prefix_keys, skip_blocks)
+        return (
+            alloc.available() - cached
+            >= req.total_blocks - skip_blocks
+        )
+
+    def _allocate_locked(self, req: _Request) -> None:
+        """Pin the request's prefix-cache hit (if any) and reserve the
+        rest of its pages; build its table row. Runs under the lock in
+        the same critical section as the gate."""
+        alloc = self._kv.alloc
+        shared = alloc.match_prefix(req.prefix_keys)
+        skip = self._skip_for(req, len(shared))
+        skip_blocks = skip // self._kv.block_len
+        if len(shared) > skip_blocks:
+            # Hit blocks beyond the chunk-aligned usable window: unpin
+            # them again (they stay cached).
+            alloc.release(shared[skip_blocks:])
+            shared = shared[:skip_blocks]
+        req.skip = skip
+        req.offset = skip
+        req.n_shared = skip_blocks
+        req.block_ids = shared + alloc.reserve(
+            req.total_blocks - skip_blocks
+        )
+        row = self._tables[req.slot]
+        row[:] = NULL_BLOCK
+        row[: len(req.block_ids)] = req.block_ids
+        if skip:
+            self._prefix_hits += 1
+            self._prefix_tokens_saved += skip
+        else:
+            self._prefix_misses += 1
+        self._observe_prefix(skip)
+
     # -- prefill -------------------------------------------------------
     def _advance_prefill(self) -> bool:
         """Admit (if idle) and advance the current prefill by ONE
-        chunk. Returns whether prefill work happened."""
+        chunk, written straight into the request's pages. Returns
+        whether prefill work happened."""
         import jax.numpy as jnp
 
         with self._lock:
             req = self._prefilling
             if req is None:
-                admitted = self._sched.admit_next()
+                admitted = self._sched.admit_next(
+                    gate=self._gate_locked
+                )
                 if admitted is None:
                     return False
                 req, slot = admitted
                 req.slot = slot
+                self._allocate_locked(req)
                 self._prefilling = req
-        if req.prompt_cache is None:
-            req.prompt_cache = self._kv.fresh_prompt_cache(req.bucket)
+        if req.padded is None:
             padded = np.zeros((1, req.bucket), np.int32)
             padded[0, : len(req.prompt)] = req.prompt
             req.padded = padded
-        from ..models.generate import prefill
+        from ..models.generate import paged_prefill
 
         chunk = self.config.prefill_chunk
         t0 = time.perf_counter()
         tokens = jnp.asarray(req.padded[:, req.offset:req.offset + chunk])
-        logits, req.prompt_cache = prefill(
+        table = jnp.asarray(self._tables[req.slot:req.slot + 1])
+        logits, pool = paged_prefill(
             self.params,
             self.cfg,
             tokens,
-            req.prompt_cache,
+            self._kv.pool,
+            table,
             jnp.int32(req.offset),
             jnp.int32(req.offset + chunk),
         )
+        self._kv.pool = pool
         req.offset += chunk
         last_chunk = req.offset >= req.bucket
         if last_chunk:
             # Next-token logits come from the prompt's LAST REAL
             # position (inside this chunk by bucket construction:
             # the final chunk covers [bucket - chunk, bucket) and
-            # len(prompt) > bucket - chunk).
+            # len(prompt) > bucket - chunk — prefix skip never
+            # reaches the final chunk, it is capped at
+            # len(prompt) - 1).
             local = len(req.prompt) - 1 - (req.offset - chunk)
             last_row = logits[0, local]
-            self._kv.insert(req.slot, req.prompt_cache)
             self._last_logits = self._last_logits.at[req.slot].set(
                 last_row
             )
@@ -456,7 +592,6 @@ class InferenceEngine:
             (time.perf_counter() - t0) * 1e3, chunk
         )
         if last_chunk:
-            req.prompt_cache = None
             req.padded = None
             with self._lock:
                 self._prefilling = None
@@ -465,6 +600,16 @@ class InferenceEngine:
                 if req.cancelled.is_set():
                     self._release_locked(req.slot, req, "cancelled")
                     return True
+                if self.config.prefix_cache:
+                    # Publish the full prompt blocks this request
+                    # computed (not the ones it shared) for future
+                    # prefix hits; first writer wins on races.
+                    for i in range(
+                        req.n_shared, len(req.prefix_keys)
+                    ):
+                        self._kv.alloc.register(
+                            req.block_ids[i], req.prefix_keys[i]
+                        )
                 self._positions[req.slot] = len(req.prompt)
                 self._alive[req.slot] = True
         return True
@@ -474,7 +619,7 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
 
-        from ..models.generate import decode_step
+        from ..models.generate import paged_decode_step
 
         alive_idx = np.flatnonzero(self._alive)
         if alive_idx.size == 0:
@@ -483,10 +628,11 @@ class InferenceEngine:
         ec = self.config
         t0 = time.perf_counter()
         key = jax.random.fold_in(self._base_key, self._steps)
-        token, cache, last_logits = decode_step(
+        token, pool, last_logits = paged_decode_step(
             self.params,
             self.cfg,
-            self._kv.cache,
+            self._kv.pool,
+            jnp.asarray(self._tables),
             self._last_logits,
             jnp.asarray(self._positions),
             jnp.asarray(self._alive),
@@ -494,7 +640,7 @@ class InferenceEngine:
             temperature=ec.temperature,
             top_k=ec.top_k,
         )
-        self._kv.cache = cache
+        self._kv.pool = pool
         self._last_logits = last_logits
         tokens = np.asarray(token)  # device->host sync per step
         step_ms = (time.perf_counter() - t0) * 1e3
@@ -529,6 +675,14 @@ class InferenceEngine:
     # never fail a decode (serve/observability.py owns the metric
     # definitions; the engine just reports).
 
+    def _block_stats(self) -> Dict[str, int]:
+        alloc = self._kv.alloc
+        return {
+            "kv_used": alloc.used(),
+            "kv_total": alloc.capacity(),
+            "kv_cached": alloc.cached(),
+        }
+
     def _observe_step(
         self, step_ms: float, batch: int, tokens: int
     ) -> None:
@@ -539,7 +693,7 @@ class InferenceEngine:
             observe_engine_step(
                 self._tags, step_ms, batch, tokens,
                 stats["slots_used"], stats["slots_total"],
-                stats["waiting"],
+                stats["waiting"], **self._block_stats(),
             )
         except Exception:
             pass
@@ -549,6 +703,14 @@ class InferenceEngine:
             from ..serve.observability import observe_engine_prefill
 
             observe_engine_prefill(self._tags, chunk_ms, tokens)
+        except Exception:
+            pass
+
+    def _observe_prefix(self, skip_tokens: int) -> None:
+        try:
+            from ..serve.observability import observe_engine_prefix
+
+            observe_engine_prefix(self._tags, skip_tokens)
         except Exception:
             pass
 
@@ -578,6 +740,7 @@ class InferenceEngine:
             observe_engine_occupancy(
                 self._tags, stats["slots_used"],
                 stats["slots_total"], stats["waiting"],
+                **self._block_stats(),
             )
         except Exception:
             pass

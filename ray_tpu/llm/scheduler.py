@@ -59,13 +59,20 @@ class SlotScheduler:
             )
         self._waiting.append(request)
 
-    def admit_next(self) -> Optional[Tuple[Any, int]]:
+    def admit_next(self, gate=None) -> Optional[Tuple[Any, int]]:
         """Pop the FIFO head into a free slot; None when nothing can
-        be admitted (no waiters or no free slot)."""
+        be admitted (no waiters or no free slot). `gate(request) ->
+        bool` may veto the head — the paged engine gates on KV-block
+        availability — and a vetoed head STAYS the head: admission
+        remains strict FIFO (no skip-ahead), so a big request waits
+        for blocks instead of being starved by smaller ones."""
         if not self._waiting or not self._free:
             return None
+        request = self._waiting[0]
+        if gate is not None and not gate(request):
+            return None
         slot = self._free.pop()
-        request = self._waiting.popleft()
+        self._waiting.popleft()
         self._running[slot] = request
         return request, slot
 

@@ -341,9 +341,18 @@ def build_llm_app(
     from .._private.config import Config
     from ..serve.deployment import deployment as serve_deployment
 
+    runtime_cfg = Config.from_env()
     if engine_enabled is None:
-        engine_enabled = Config.from_env().serve_engine_enabled
-    engine_cfg = EngineConfig(**(engine or {}))
+        engine_enabled = runtime_cfg.serve_engine_enabled
+    engine = dict(engine or {})
+    if "prefix_cache" not in engine:
+        # Same driver-side resolution as the engine kill switch: the
+        # decision ships in the replica init args instead of depending
+        # on worker-process environments.
+        engine["prefix_cache"] = bool(
+            runtime_cfg.serve_prefix_cache_enabled
+        )
+    engine_cfg = EngineConfig(**engine)
     if max_ongoing_requests is None:
         # Streams hold a replica thread for their whole lifetime:
         # admit enough for every slot plus a queueing margin so the

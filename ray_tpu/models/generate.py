@@ -267,6 +267,245 @@ def prefill(params, cfg: LlamaConfig, tokens, cache, cache_pos, valid_len):
     )
 
 
+# ---------------------------------------------------------------------
+# Paged KV: one shared block pool instead of per-row [max_len] arenas.
+# A sequence's cache lives in `block_len`-sized blocks scattered across
+# the pool; a per-row BLOCK TABLE maps logical block j -> physical
+# block id. Attention gathers each row's blocks back into logical
+# order, so the math is identical to the contiguous cache above with
+# max_len == n_logical_blocks * block_len — the paged engine stays
+# token-for-token equal to `generate()` (llm/kv_slots.py owns the
+# allocator/refcounting; this module owns the compute).
+# ---------------------------------------------------------------------
+
+
+def init_block_pool(
+    cfg: LlamaConfig, n_blocks: int, block_len: int
+) -> Dict[str, jax.Array]:
+    """The shared pool: k/v of shape
+    [layers, n_blocks, kv_heads, block_len, head_dim]."""
+    shape = (
+        cfg.n_layers,
+        n_blocks,
+        cfg.n_kv_heads,
+        block_len,
+        cfg.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _paged_layer(
+    cfg: LlamaConfig,
+    x: jax.Array,  # [b, t, dim]
+    layer: Dict[str, jax.Array],
+    cos,
+    sin,
+    k_pool,  # [n_blocks, kv_heads, block_len, hd] (one layer's slice)
+    v_pool,
+    tables: jax.Array,  # [b, n_logical_blocks] physical block ids
+    q_pos: jax.Array,  # [b, t] absolute positions of x's tokens
+    valid_len: jax.Array,  # [b] valid cache length incl. x
+):
+    b, t, _ = x.shape
+    hd = cfg.head_dim
+    bl = k_pool.shape[2]
+    nb = tables.shape[1]
+    h = model_norm(cfg, x, layer["attn_norm"])
+    q, k, v = project_qkv(cfg, h, layer)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    # Scatter this step's k/v: position p of row i lands in physical
+    # block tables[i, p // bl] at offset p % bl. Rows never share a
+    # writable block (the allocator hands a block to one sequence;
+    # dead rows all point at the reserved null block 0, whose junk is
+    # never gathered by a live row), so the flattened scatter indices
+    # only collide harmlessly on the null block.
+    phys = jnp.take_along_axis(tables, q_pos // bl, axis=1)  # [b, t]
+    off = q_pos % bl
+    flat_phys = phys.reshape(-1)
+    flat_off = off.reshape(-1)
+    k_rows = k.transpose(0, 2, 1, 3).reshape(b * t, cfg.n_kv_heads, hd)
+    v_rows = v.transpose(0, 2, 1, 3).reshape(b * t, cfg.n_kv_heads, hd)
+    k_pool = k_pool.at[flat_phys, :, flat_off].set(
+        k_rows.astype(k_pool.dtype)
+    )
+    v_pool = v_pool.at[flat_phys, :, flat_off].set(
+        v_rows.astype(v_pool.dtype)
+    )
+    # Gather each row's cache back into logical order: [b, nb, kvH,
+    # bl, hd] -> [b, kvH, nb*bl, hd]. Gather AFTER the scatter so the
+    # chunk attends to its own tokens (prefill self-attention).
+    kf = k_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+        b, cfg.n_kv_heads, nb * bl, hd
+    )
+    vf = v_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+        b, cfg.n_kv_heads, nb * bl, hd
+    )
+    groups = cfg.n_heads // cfg.n_kv_heads
+    kf = jnp.repeat(kf, groups, axis=1)
+    vf = jnp.repeat(vf, groups, axis=1)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = (
+        jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32),
+            kf.astype(jnp.float32),
+        )
+        * scale
+    )
+    k_pos = jnp.arange(nb * bl)
+    mask = (k_pos[None, None, :] <= q_pos[:, :, None]) & (
+        k_pos[None, None, :] < valid_len[:, None, None]
+    )  # [b, t, nb*bl]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vf.astype(jnp.float32))
+    attn = attn.astype(cfg.dtype).transpose(0, 2, 1, 3).reshape(b, t, -1)
+    x = x + attn @ layer["wo"]
+    h = model_norm(cfg, x, layer["mlp_norm"])
+    x = x + model_glu(cfg, h @ layer["w1"], h @ layer["w3"]) @ layer["w2"]
+    return x, k_pool, v_pool
+
+
+def _paged_forward(
+    params, cfg: LlamaConfig, tokens, pool, tables, q_pos, valid_len
+):
+    """tokens [b, t] at absolute positions q_pos [b, t] -> (logits
+    [b, t, vocab], new pool). The paged analog of
+    `_forward_with_cache`; `tables` maps each row's logical blocks to
+    pool blocks and `valid_len` [b] bounds what attention may see."""
+    q_pos = jnp.asarray(q_pos, jnp.int32)
+    valid_len = jnp.asarray(valid_len, jnp.int32)
+    x = embed_tokens(cfg, params, tokens)
+    cos, sin = rotary_embedding(
+        q_pos, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_pool, v_pool = inputs
+        x, k_pool, v_pool = _paged_layer(
+            cfg, x, layer, cos, sin, k_pool, v_pool, tables, q_pos,
+            valid_len,
+        )
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    x = model_norm(cfg, x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _paged_prefill_impl(
+    params, cfg: LlamaConfig, tokens, pool, table, offset, valid_len
+):
+    b, t = tokens.shape
+    q_pos = (
+        jnp.asarray(offset, jnp.int32)
+        + jnp.broadcast_to(jnp.arange(t), (b, t))
+    )
+    return _paged_forward(
+        params, cfg, tokens, pool, table,
+        q_pos, jnp.broadcast_to(jnp.asarray(valid_len, jnp.int32), (b,)),
+    )
+
+
+_paged_prefill_jit = None
+
+
+def paged_prefill(
+    params, cfg: LlamaConfig, tokens, pool, table, offset, valid_len
+):
+    """Jitted chunked prefill straight into the block pool: one
+    forward over `tokens` [1, chunk] at positions [offset, offset +
+    chunk) of the sequence whose block table is `table` [1, nb].
+    Because the chunk shape and the table width are static while
+    `offset` is traced, this compiles ONCE per (chunk, nb, model) —
+    not once per prompt bucket — and a prefix-cache hit simply starts
+    at a later offset with the shared blocks already in the pool.
+    `pool` is donated on accelerator backends."""
+    global _paged_prefill_jit
+    if _paged_prefill_jit is None:
+        _paged_prefill_jit = partial(
+            jax.jit,
+            static_argnames=("cfg",),
+            donate_argnums=accel_donate(3),
+        )(_paged_prefill_impl)
+    return _paged_prefill_jit(
+        params, cfg, tokens, pool, table, offset, valid_len
+    )
+
+
+def _paged_decode_step_impl(
+    params,
+    cfg: LlamaConfig,
+    pool,
+    tables,
+    last_logits,
+    positions,
+    alive,
+    key,
+    temperature: float,
+    top_k: int,
+):
+    token = _sample(last_logits, key, temperature, top_k)
+    token = jnp.where(alive, token, 0)
+    # Dead rows must not scatter into REAL blocks: a freed slot's
+    # table is zeroed host-side, but a slot mid-admission (its table
+    # already built, its prefill still running, alive not yet set)
+    # would otherwise write this step's junk k/v at its STALE position
+    # into the new request's — possibly shared prefix-cache — pages.
+    # Masking to the null block here makes the guarantee kernel-level,
+    # independent of host bookkeeping order.
+    tables = jnp.where(alive[:, None], tables, 0)
+    logits, pool = _paged_forward(
+        params, cfg, token[:, None], pool, tables,
+        positions[:, None], positions + 1,
+    )
+    return token, pool, logits[:, 0]
+
+
+_paged_decode_jit = None
+
+
+def paged_decode_step(
+    params,
+    cfg: LlamaConfig,
+    pool,
+    tables,
+    last_logits,
+    positions,
+    alive,
+    key,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+):
+    """Jitted single-step decode over the FULL slot batch against the
+    block pool (the paged analog of `decode_step`): sample one token
+    per row from `last_logits`, scatter its k/v into each row's
+    current block, and gather-attend over the row's block table.
+    Compiles once per (batch, pool, table) shape. `pool` and
+    `last_logits` are donated on accelerator backends — treat them as
+    consumed."""
+    global _paged_decode_jit
+    if _paged_decode_jit is None:
+        _paged_decode_jit = partial(
+            jax.jit,
+            static_argnames=("temperature", "top_k", "cfg"),
+            donate_argnums=accel_donate(2, 4),
+        )(_paged_decode_step_impl)
+    return _paged_decode_jit(
+        params, cfg, pool, tables, last_logits, positions, alive, key,
+        temperature=temperature, top_k=top_k,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(

@@ -21,7 +21,11 @@ from .deployment import (
     deployment,
 )
 from .proxy import Request
-from .router import DeploymentHandle, DeploymentResponse
+from .router import (
+    DeploymentHandle,
+    DeploymentOverloaded,
+    DeploymentResponse,
+)
 
 __all__ = [
     "deployment",
@@ -42,6 +46,7 @@ __all__ = [
     "shutdown",
     "get_app_handle",
     "DeploymentHandle",
+    "DeploymentOverloaded",
     "DeploymentResponse",
     "Request",
 ]

@@ -60,6 +60,7 @@ __all__ = [
     "replica_executing",
     "observe_engine_step",
     "observe_engine_prefill",
+    "observe_engine_prefix",
     "observe_engine_ttft",
     "observe_engine_finish",
     "deployment_snapshot",
@@ -407,6 +408,9 @@ def observe_engine_step(
     slots_used: int,
     slots_total: int,
     waiting: int,
+    kv_used: Optional[int] = None,
+    kv_total: Optional[int] = None,
+    kv_cached: Optional[int] = None,
 ) -> None:
     """Engine: one decode iteration over the slot batch."""
     if not _ENABLED:
@@ -427,7 +431,10 @@ def observe_engine_step(
                 "Tokens sampled by the engine's decode loop",
                 ENGINE_TAGS,
             ).inc(float(tokens), tags=tags)
-        _engine_gauges(tags, slots_used, slots_total, waiting)
+        _engine_gauges(
+            tags, slots_used, slots_total, waiting,
+            kv_used, kv_total, kv_cached,
+        )
     except Exception:
         pass
 
@@ -448,6 +455,38 @@ def observe_engine_prefill(
             "Prompt tokens prefilled by the engine",
             ENGINE_TAGS,
         ).inc(float(tokens), tags=tags)
+    except Exception:
+        pass
+
+
+def observe_engine_prefix(
+    tags: Dict[str, str], skip_tokens: int
+) -> None:
+    """Engine: one admission's prefix-cache outcome. A HIT means the
+    request skipped `skip_tokens` of prefill by pinning pooled blocks
+    (hit-rate = hits / (hits + misses) over the counters)."""
+    if not _ENABLED:
+        return
+    try:
+        name = (
+            "serve_engine_prefix_hits_total"
+            if skip_tokens
+            else "serve_engine_prefix_misses_total"
+        )
+        _counter(
+            name,
+            "Engine admissions whose prompt prefix "
+            + ("hit" if skip_tokens else "missed")
+            + " the paged KV prefix cache",
+            ENGINE_TAGS,
+        ).inc(1.0, tags=tags)
+        if skip_tokens:
+            _counter(
+                "serve_engine_prefix_tokens_saved_total",
+                "Prompt tokens whose prefill was skipped via "
+                "prefix-cache hits",
+                ENGINE_TAGS,
+            ).inc(float(skip_tokens), tags=tags)
     except Exception:
         pass
 
@@ -484,15 +523,21 @@ def observe_engine_occupancy(
     slots_used: int,
     slots_total: int,
     waiting: int,
+    kv_used: Optional[int] = None,
+    kv_total: Optional[int] = None,
+    kv_cached: Optional[int] = None,
 ) -> None:
     """Engine: occupancy push OUTSIDE the decode step — cancellation,
-    request retirement, and engine unload all free slots without a
-    following step, and the gauges must not report phantom occupancy
-    until the next request arrives."""
+    request retirement, and engine unload all free slots (and unpin
+    KV blocks) without a following step, and the gauges must not
+    report phantom occupancy until the next request arrives."""
     if not _ENABLED:
         return
     try:
-        _engine_gauges(tags, slots_used, slots_total, waiting)
+        _engine_gauges(
+            tags, slots_used, slots_total, waiting,
+            kv_used, kv_total, kv_cached,
+        )
     except Exception:
         pass
 
@@ -502,10 +547,13 @@ def _engine_gauges(
     slots_used: int,
     slots_total: int,
     waiting: int,
+    kv_used: Optional[int] = None,
+    kv_total: Optional[int] = None,
+    kv_cached: Optional[int] = None,
 ) -> None:
-    """Slot-occupancy gauges, throttled like replica_executing:
-    zero-crossing edges always push, same-sign updates at most one
-    per period per engine."""
+    """Slot-occupancy + KV-block gauges, throttled like
+    replica_executing: zero-crossing edges always push, same-sign
+    updates at most one per period per engine."""
     key = ("engine", tags.get("app", ""), tags.get("deployment", ""),
            tags.get("family", ""))
     now = time.monotonic()
@@ -514,7 +562,7 @@ def _engine_gauges(
     if not edge and now - last_ts < _GAUGE_MIN_INTERVAL_S:
         return
     _gauge_last[key] = (now, slots_used)
-    for name, desc, value in (
+    series = [
         (
             "serve_engine_slots_used",
             "KV slots occupied by decoding sequences",
@@ -530,7 +578,26 @@ def _engine_gauges(
             "Requests queued for a free engine slot",
             waiting,
         ),
-    ):
+    ]
+    if kv_used is not None:
+        series.append((
+            "serve_engine_kv_blocks_used",
+            "Paged-KV blocks pinned by live requests",
+            kv_used,
+        ))
+    if kv_total is not None:
+        series.append((
+            "serve_engine_kv_blocks_total",
+            "Paged-KV blocks provisioned in the engine's pool",
+            kv_total,
+        ))
+    if kv_cached is not None:
+        series.append((
+            "serve_engine_kv_blocks_cached",
+            "Refcount-0 paged-KV blocks retained for prefix reuse",
+            kv_cached,
+        ))
+    for name, desc, value in series:
         _gauge(name, desc, ENGINE_TAGS).set(
             float(value), tags=tags
         )
@@ -664,6 +731,30 @@ def _fold_engine(summary: Dict[str, dict], row, out) -> None:
             "tokens_total", float(s.get("total", 0.0) or 0.0)
         ),
     )
+    fold(
+        "serve_engine_kv_blocks_used",
+        lambda t, s: t.__setitem__(
+            "kv_blocks_used", float(s.get("value", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_kv_blocks_total",
+        lambda t, s: t.__setitem__(
+            "kv_blocks_total", float(s.get("value", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_prefix_hits_total",
+        lambda t, s: t.__setitem__(
+            "prefix_hits", float(s.get("total", 0.0) or 0.0)
+        ),
+    )
+    fold(
+        "serve_engine_prefix_misses_total",
+        lambda t, s: t.__setitem__(
+            "prefix_misses", float(s.get("total", 0.0) or 0.0)
+        ),
+    )
 
     def histo(target: dict, series: dict, prefix: str) -> None:
         if not series.get("count"):
@@ -693,6 +784,8 @@ def _fold_engine(summary: Dict[str, dict], row, out) -> None:
             continue
         for key in (
             "slots_used", "slots_total", "waiting", "tokens_total",
+            "kv_blocks_used", "kv_blocks_total",
+            "prefix_hits", "prefix_misses",
         ):
             target[f"engine_{key}"] = sum(
                 f.get(key, 0.0) for f in families.values()

@@ -86,6 +86,10 @@ class Proxy:
         self._max_connections = max_connections
         self.shed_requests = 0  # observability: /-/healthz surfaces it
         self.shed_connections = 0
+        # SLO admission sheds (router raised DeploymentOverloaded:
+        # every candidate replica's outstanding-token estimate is over
+        # threshold — see serve/router.py).
+        self.shed_slo = 0
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -141,6 +145,11 @@ class Proxy:
                     )
                     if request_id:
                         self.send_header("x-request-id", request_id)
+                    retry_after = getattr(
+                        self, "_rt_retry_after", None
+                    )
+                    if retry_after:
+                        self.send_header("Retry-After", retry_after)
                     self.send_header(
                         "Content-Length", str(len(payload))
                     )
@@ -320,8 +329,9 @@ class Proxy:
     def _dispatch(self, handler) -> Tuple[int, bytes, str]:
         # The Handler instance persists across keep-alive requests:
         # clear per-request state up front so no response (healthz
-        # included) can echo a PREVIOUS request's id.
+        # included) can echo a PREVIOUS request's id or Retry-After.
         handler._rt_request_id = None
+        handler._rt_retry_after = None
         parsed = urlparse(handler.path)
         if parsed.path == "/-/healthz":
             return self._healthz(handler)
@@ -339,6 +349,7 @@ class Proxy:
                 "connections": self._conn_count,
                 "shed_requests": self.shed_requests,
                 "shed_connections": self.shed_connections,
+                "shed_slo": self.shed_slo,
             }).encode(),
             "application/json",
         )
@@ -400,7 +411,7 @@ class Proxy:
             )
 
     def _route_request(self, handler, parsed, request_id, target):
-        from .router import DeploymentHandle
+        from .router import DeploymentHandle, DeploymentOverloaded
 
         self._refresh_routes()
         match = self._match(parsed.path)
@@ -440,18 +451,38 @@ class Proxy:
             streaming = bool(
                 (handle._state["spec"] or {}).get("ingress_streaming")
             )
-        if streaming:
-            chunks = handle.options(
-                stream=True,
-                multiplexed_model_id=model_id,
-                request_id=request_id,
-            ).remote(request)
-            self._stream_response(handler, chunks)
-            return None
-        handle = handle.options(
-            multiplexed_model_id=model_id, request_id=request_id
-        )
-        value = handle.remote(request).result(timeout=60)
+        try:
+            if streaming:
+                chunks = handle.options(
+                    stream=True,
+                    multiplexed_model_id=model_id,
+                    request_id=request_id,
+                ).remote(request)
+                self._stream_response(handler, chunks)
+                return None
+            handle = handle.options(
+                multiplexed_model_id=model_id, request_id=request_id
+            )
+            response = handle.remote(request)
+        except DeploymentOverloaded as e:
+            # SLO admission shed: every candidate replica's queue is
+            # already past the latency budget — a fast 503 the client
+            # can back off on beats joining a queue whose TTFT has
+            # collapsed (the raise happens BEFORE any streaming
+            # header, so the connection stays clean).
+            with self._conn_lock:
+                self.shed_slo += 1
+            retry_after = max(1, int(round(e.retry_after_s)))
+            handler._rt_retry_after = str(retry_after)
+            return (
+                503,
+                json.dumps({
+                    "error": str(e),
+                    "retry_after_s": retry_after,
+                }).encode(),
+                "application/json",
+            )
+        value = response.result(timeout=60)
         if isinstance(value, bytes):
             return 200, value, "application/octet-stream"
         if isinstance(value, str):

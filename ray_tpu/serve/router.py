@@ -1,16 +1,32 @@
-"""DeploymentHandle + power-of-two-choices routing.
+"""DeploymentHandle + least-outstanding-tokens routing.
 
 Reference: python/ray/serve/handle.py (DeploymentHandle /
 DeploymentResponse) and _private/replica_scheduler/pow_2_scheduler.py:52
-— pick two random replicas (preferring replicas on THIS node, the
-reference's locality-aware candidate selection), send to the one with
-fewer ongoing requests tracked by this router. Replica membership and
-deployment specs arrive by CONTROLLER PUSH over a long-poll listener
-(reference: long_poll.py LongPollClient) — a redeploy is visible here
-within one push round-trip, not a cache-TTL window. Batched methods
-group concurrent calls handle-side into one replica call (reference:
-serve/batching.py, relocated to the router because replicas execute
-serially here).
+for the candidate-selection skeleton (model-warm replicas first, then
+replicas on THIS node). Routing itself (ISSUE 11) is by LEAST
+OUTSTANDING TOKENS: the router keeps a per-replica estimate of queued
+work in TOKENS (prompt + token budget parsed from LLM payloads, a
+flat default otherwise), decays it as stream chunks come back, and
+sends each request to the candidate with the smallest estimate — a
+40-token chat turn and a 200-token completion stop counting as equal
+load the way in-flight REQUEST counts made them
+(`serve_routing_policy=pow2` restores power-of-two-choices on request
+counts). The estimate is released on EVERY exit path — exhaustion,
+`.close()`/abandon, stream error — and entries for replicas that left
+the membership (engine death, redeploy) are pruned on the long-poll
+push, so phantom load can't pile onto a dead or cancelled stream's
+replica. SLO admission control rides the same estimate: when even the
+least-loaded candidate is over `serve_slo_queue_threshold_tokens`,
+`remote()` raises DeploymentOverloaded and the proxy sheds with
+503 + Retry-After instead of queueing into TTFT collapse (kill
+switch RT_serve_slo_admission_enabled).
+
+Replica membership and deployment specs arrive by CONTROLLER PUSH
+over a long-poll listener (reference: long_poll.py LongPollClient) —
+a redeploy is visible here within one push round-trip, not a
+cache-TTL window. Batched methods group concurrent calls handle-side
+into one replica call (reference: serve/batching.py, relocated to the
+router because replicas execute serially here).
 """
 
 from __future__ import annotations
@@ -22,6 +38,97 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from .controller import CONTROLLER_NAME
+
+
+class DeploymentOverloaded(RuntimeError):
+    """Every candidate replica's outstanding-token estimate is over
+    the SLO admission threshold; shed (HTTP: 503 + Retry-After)
+    instead of queueing."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+#: Outstanding-token estimate for requests whose payload carries no
+#: prompt/budget (non-LLM deployments): one flat unit of work.
+DEFAULT_TOKEN_ESTIMATE = 64
+
+
+#: Process-wide routing/admission config, resolved from the
+#: environment ONCE (the router sits on every request's hot path —
+#: re-scanning os.environ per call would tax every chunk of every
+#: stream). Tests that monkeypatch RT_serve_* env vars call
+#: _reset_config_cache().
+_config_cache = None
+
+
+def _serve_config():
+    global _config_cache
+    if _config_cache is None:
+        from .._private.config import Config
+
+        _config_cache = Config.from_env()
+    return _config_cache
+
+
+def _reset_config_cache() -> None:
+    global _config_cache
+    _config_cache = None
+
+
+def estimate_request_tokens(args: tuple, kwargs: dict) -> int:
+    """Outstanding-token estimate for one request: prompt length +
+    token budget when the payload exposes them (LLM dict payloads and
+    proxy Request bodies), DEFAULT_TOKEN_ESTIMATE otherwise. A
+    heuristic for LOAD RANKING — it only needs to order replicas, not
+    to be exact."""
+    del kwargs
+    payload = args[0] if args else None
+    if hasattr(payload, "json"):
+        try:
+            payload = payload.json()
+        except Exception:
+            payload = None
+    if isinstance(payload, dict):
+        estimate = 0
+        prompt = payload.get("prompt")
+        if isinstance(prompt, (list, tuple, str)):
+            estimate += len(prompt)
+        budget = payload.get("max_new_tokens")
+        if budget is not None:
+            try:
+                estimate += max(0, int(budget))
+            except (TypeError, ValueError):
+                pass
+        elif estimate:
+            estimate += DEFAULT_TOKEN_ESTIMATE
+        if estimate > 0:
+            return estimate
+    return DEFAULT_TOKEN_ESTIMATE
+
+
+def pick_least_outstanding(
+    replicas: List[dict], outstanding: Dict[str, int]
+) -> dict:
+    """The routing policy, as a pure function (unit-tested in
+    tests/test_router_policy.py): the candidate with the fewest
+    estimated outstanding tokens, ties broken uniformly at random
+    (reservoir over the tied prefix) so idle replicas share cold
+    traffic instead of all of it landing on the first in list
+    order."""
+    best = None
+    best_load = None
+    ties = 0
+    for replica in replicas:
+        load = outstanding.get(replica["id"], 0)
+        if best is None or load < best_load:
+            best, best_load, ties = replica, load, 1
+        elif load == best_load:
+            ties += 1
+            if random.random() < 1.0 / ties:
+                best = replica
+    return best
 
 
 def _controller():
@@ -58,20 +165,37 @@ class DeploymentResponse:
         self._waiter = waiter  # callable(timeout) -> value
         self._router = router
         self._resolved = False
+        self._released = False
         self._value = None
+        self._tokens = 0  # outstanding-token estimate to release
+
+    def _release(self) -> None:
+        """Release the in-flight count + outstanding-token estimate
+        exactly once — from result(), or from GC for a response the
+        caller fired and dropped (without this, a handful of dropped
+        responses would pin phantom load on a replica forever and
+        eventually trip SLO admission into permanent 503s)."""
+        if self._released:
+            return
+        self._released = True
+        replica_id = getattr(self, "_replica_id", None)
+        self._router._ongoing_done(replica_id)
+        self._router._tokens_done(replica_id, self._tokens)
+        self._tokens = 0
 
     def result(self, timeout: Optional[float] = 30.0):
         if not self._resolved:
             try:
                 self._value = self._waiter(timeout)
             finally:
-                self._router._ongoing_done(
-                    getattr(self, "_replica_id", None)
-                )
+                self._release()
             self._resolved = True
         if isinstance(self._value, BaseException):
             raise self._value
         return self._value
+
+    def __del__(self):
+        self._release()
 
 
 class DeploymentResponseGenerator:
@@ -93,12 +217,14 @@ class DeploymentResponseGenerator:
         replica_id,
         actor=None,
         request_id: str = "",
+        tokens: int = 0,
     ):
         self._gen = ref_gen
         self._router = router
         self._replica_id = replica_id
         self._actor = actor
         self._request_id = request_id
+        self._tokens_left = int(tokens)
         self._finished = False
         self._exhausted = False
 
@@ -112,7 +238,7 @@ class DeploymentResponseGenerator:
             raise StopIteration
         try:
             ref = next(self._gen)
-            return rt.get(ref, timeout=60)
+            value = rt.get(ref, timeout=60)
         except StopIteration:
             self._exhausted = True
             self.close()
@@ -120,19 +246,33 @@ class DeploymentResponseGenerator:
         except BaseException:
             self.close()
             raise
+        # One chunk ≈ one token of the estimate done: the replica's
+        # outstanding-token load decays AS the stream progresses, so
+        # routing sees a request 90% through its budget as almost
+        # free, not as a full request's worth of load.
+        if self._tokens_left > 0:
+            self._tokens_left -= 1
+            self._router._tokens_done(self._replica_id, 1)
+        return value
 
     def close(self) -> None:
-        """Release the ongoing-count slot exactly once, and tell the
-        replica when the stream was ABANDONED (client disconnect,
-        break) rather than exhausted: a continuous-batching engine
-        frees the request's KV slot mid-decode instead of decoding
-        the rest of the token budget for nobody. Without the
-        ongoing-count release, phantom in-flight load would skew
-        pow-2 routing and pin the autoscaler up forever."""
+        """Release the ongoing-count slot and the REMAINING
+        outstanding-token estimate exactly once, and tell the replica
+        when the stream was ABANDONED (client disconnect, break)
+        rather than exhausted: a continuous-batching engine frees the
+        request's KV slot mid-decode instead of decoding the rest of
+        the token budget for nobody. The token release is the
+        router-side half of that cancel path (ISSUE 11 phantom-load
+        fix): without it an abandoned or engine-failed stream would
+        keep its full remaining budget counted against the replica
+        until process exit, skewing least-outstanding-tokens routing
+        and SLO admission forever."""
         if self._finished:
             return
         self._finished = True
         self._router._ongoing_done(self._replica_id)
+        self._router._tokens_done(self._replica_id, self._tokens_left)
+        self._tokens_left = 0
         if (
             not self._exhausted
             and self._actor is not None
@@ -248,6 +388,10 @@ class DeploymentHandle:
             "spec": None,
         }
         self._ongoing: Dict[str, int] = {}  # replica_id -> in flight
+        #: replica_id -> estimated outstanding TOKENS (the routing +
+        #: SLO-admission signal; shared across method clones like
+        #: _ongoing so one handle family sees one load picture).
+        self._outstanding_tokens: Dict[str, int] = {}
         self._sent = 0
         self._done = 0
         self._batchers: Dict[str, _BatchQueue] = {}
@@ -288,6 +432,7 @@ class DeploymentHandle:
             self._state["replicas"] = replicas
             self._state["replicas_ts"] = time.time()
             self._state["spec"] = spec
+            self._prune_gone_locked()
         self._ensure_listener()
 
     def _ensure_listener(self) -> None:
@@ -333,6 +478,13 @@ class DeploymentHandle:
                     if key.startswith("replicas:"):
                         self._state["replicas"] = update["value"] or []
                         self._state["replicas_ts"] = time.time()
+                        # Replicas that left the membership (engine/
+                        # replica death, redeploy) take their load
+                        # estimates with them — their streams will
+                        # never decrement, and phantom load on a dead
+                        # id must not deter routing to its
+                        # replacement (ISSUE 11 phantom-load fix).
+                        self._prune_gone_locked()
                     elif update["value"] is not None:
                         self._state["spec"] = update["value"]
 
@@ -374,20 +526,35 @@ class DeploymentHandle:
                 replicas = local
         if len(replicas) == 1:
             return replicas[0]
-        # Power of two choices on this router's in-flight counts.
-        a, b = random.sample(replicas, 2)
+        if _serve_config().serve_routing_policy == "pow2":
+            # Legacy policy: power of two choices on this router's
+            # in-flight REQUEST counts.
+            a, b = random.sample(replicas, 2)
+            with self._lock:
+                na = self._ongoing.get(a["id"], 0)
+                nb = self._ongoing.get(b["id"], 0)
+            return a if na <= nb else b
+        # Least outstanding tokens over the full candidate set
+        # (replica counts are small; a full scan beats sampling noise).
         with self._lock:
-            na = self._ongoing.get(a["id"], 0)
-            nb = self._ongoing.get(b["id"], 0)
-        return a if na <= nb else b
+            return pick_least_outstanding(
+                replicas, self._outstanding_tokens
+            )
 
-    def _ongoing_sent(self, replica_id: Optional[str] = None) -> None:
+    def _ongoing_sent(
+        self, replica_id: Optional[str] = None, tokens: int = 0
+    ) -> None:
         with self._lock:
             self._sent += 1
             if replica_id:
                 self._ongoing[replica_id] = (
                     self._ongoing.get(replica_id, 0) + 1
                 )
+                if tokens > 0:
+                    self._outstanding_tokens[replica_id] = (
+                        self._outstanding_tokens.get(replica_id, 0)
+                        + tokens
+                    )
         self._ensure_reporter()
 
     def _ongoing_done(self, replica_id: Optional[str] = None) -> None:
@@ -395,6 +562,32 @@ class DeploymentHandle:
             self._done += 1
             if replica_id and self._ongoing.get(replica_id, 0) > 0:
                 self._ongoing[replica_id] -= 1
+
+    def _tokens_done(
+        self, replica_id: Optional[str], tokens: int
+    ) -> None:
+        """Release `tokens` of a replica's outstanding estimate,
+        floored at zero (estimates are heuristic; a floor beats a
+        slowly-accreting negative bias)."""
+        if not replica_id or tokens <= 0:
+            return
+        with self._lock:
+            remaining = (
+                self._outstanding_tokens.get(replica_id, 0) - tokens
+            )
+            if remaining > 0:
+                self._outstanding_tokens[replica_id] = remaining
+            else:
+                self._outstanding_tokens.pop(replica_id, None)
+
+    def _prune_gone_locked(self) -> None:
+        """Drop load accounting for replicas no longer in the
+        membership (caller holds the lock)."""
+        live = {r["id"] for r in self._state["replicas"]}
+        for table in (self._ongoing, self._outstanding_tokens):
+            for replica_id in list(table):
+                if replica_id not in live:
+                    del table[replica_id]
 
     def _ensure_reporter(self) -> None:
         """Push ongoing-load metrics to the controller for autoscaling
@@ -443,6 +636,7 @@ class DeploymentHandle:
                     "_lock",
                     "_state",
                     "_ongoing",
+                    "_outstanding_tokens",
                     "_batchers",
                     "_listener_box",
                 )
@@ -533,23 +727,26 @@ class DeploymentHandle:
             self.deployment_name,
             (time.perf_counter() - t0) * 1e3,
         )
+        tokens = estimate_request_tokens(args, kwargs)
+        self._slo_admit(replica, tokens)
         ctx = self._request_ctx()
         if self._stream:
             ref_gen = replica["actor"].handle_request_streaming.options(
                 num_returns="streaming"
             ).remote(self._method, args, kwargs, self._model_id, ctx)
-            self._ongoing_sent(replica["id"])
+            self._ongoing_sent(replica["id"], tokens)
             return DeploymentResponseGenerator(
                 ref_gen,
                 self,
                 replica["id"],
                 actor=replica["actor"],
                 request_id=str(ctx.get("request_id", "")),
+                tokens=tokens,
             )
         ref = replica["actor"].handle_request.remote(
             self._method, args, kwargs, self._model_id, ctx
         )
-        self._ongoing_sent(replica["id"])
+        self._ongoing_sent(replica["id"], tokens)
 
         def waiter(timeout):
             import ray_tpu as rt
@@ -561,7 +758,30 @@ class DeploymentHandle:
 
         response = DeploymentResponse(waiter, self)
         response._replica_id = replica["id"]
+        response._tokens = tokens
         return response
+
+    def _slo_admit(self, replica: dict, tokens: int) -> None:
+        """SLO admission control: `replica` is already the LEAST-
+        loaded candidate, so its estimate over the threshold means
+        every candidate is over — queueing this request would only
+        deepen a queue that is already past the latency budget. Shed
+        instead (the proxy turns this into 503 + Retry-After)."""
+        cfg = _serve_config()
+        if not cfg.serve_slo_admission_enabled:
+            return
+        threshold = cfg.serve_slo_queue_threshold_tokens
+        if threshold <= 0:
+            return
+        with self._lock:
+            load = self._outstanding_tokens.get(replica["id"], 0)
+        if load >= threshold:
+            raise DeploymentOverloaded(
+                f"{self.app_name}/{self.deployment_name}: least-"
+                f"loaded replica has ~{load} outstanding tokens "
+                f"(threshold {threshold}); shedding {tokens}-token "
+                "request"
+            )
 
     def __reduce__(self):
         return (

@@ -16,9 +16,11 @@ Design:
   queueing collapse; open-loop is the "millions of users" shape).
   Each request runs in its own thread: POST /llm with a token-id
   prompt, stream the chunked response, timestamp every chunk.
-* Mixed lengths: prompt lengths and token budgets sample from a
-  short/long mix per request (seeded), exercising several prefill
-  buckets and ragged completions.
+* Mixed lengths: every request is a per-family SHARED SYSTEM PREFIX
+  (`--prefix-len` tokens, the realistic chat shape and what the
+  paged cache's prefix reuse feeds on) plus a random tail sampled
+  from a short/long mix (seeded), exercising several prefill buckets
+  and ragged completions.
 * Multi-family points tag requests with `serve_multiplexed_model_id`
   so the proxy/router exercise the multiplex path and BOTH families'
   engines decode concurrently (the smoke gate asserts it).
@@ -26,14 +28,21 @@ Design:
   off (`engine_enabled=False`): every request runs its own
   `generate_stream()` — serialize-per-request serving — at the same
   offered load, so the comparison isolates continuous batching.
+* `--replicas N` (ISSUE 11) adds a horizontal-scale pass: the same
+  app at num_replicas=N behind the same proxy, driven at
+  `--multi-loads`, with the router spreading by least-outstanding-
+  tokens and SLO admission shedding (503 + Retry-After) counted per
+  point — the result's `multi_replica.scaling` block compares the
+  multi-replica peak against this run's own single-replica points.
 * Engine visibility: each point samples `/api/serve` (occupancy,
-  batch p50) while traffic runs, and the result records whether the
-  engine series render on the Prometheus exposition — the
-  observability acceptance ISSUE 10 names.
+  batch p50, paged-KV blocks, prefix hits) while traffic runs, and
+  the result records whether the engine + prefix-cache series render
+  on the Prometheus exposition.
 
 Metrics per point: p50/p99 time-to-first-token, p50/p99 per-token
 latency (mean inter-token gap per request, percentiled over
-requests), aggregate tokens/s, achieved vs offered load, errors.
+requests), aggregate tokens/s, achieved vs offered load, errors,
+sheds (503s).
 """
 
 from __future__ import annotations
@@ -165,6 +174,39 @@ def _sample_engine_state(route_key):
                 default=0.0,
             ),
             "families": sorted(families),
+            "kv_blocks_used": float(
+                row.get("engine_kv_blocks_used", 0.0)
+            ),
+            "prefix_hits": float(row.get("engine_prefix_hits", 0.0)),
+            "prefix_misses": float(
+                row.get("engine_prefix_misses", 0.0)
+            ),
+        }
+    except Exception:
+        return {}
+
+
+def _prefix_totals():
+    """Cumulative prefix-cache counters off the head's metric table
+    (the /metrics numbers, summed over label sets)."""
+    try:
+        from ray_tpu.util.metrics import metrics_summary
+
+        summary = metrics_summary()
+
+        def total(name):
+            series = (summary.get(name, {}).get("by_tags") or {})
+            return sum(
+                float(s.get("total", 0.0) or 0.0)
+                for s in series.values()
+            )
+
+        return {
+            "hits": total("serve_engine_prefix_hits_total"),
+            "misses": total("serve_engine_prefix_misses_total"),
+            "tokens_saved": total(
+                "serve_engine_prefix_tokens_saved_total"
+            ),
         }
     except Exception:
         return {}
@@ -181,9 +223,12 @@ def run_point(
     prompt_mix,
     max_new_mix,
     seed,
+    system_prefixes=None,
     request_timeout_s=60.0,
 ):
-    """One offered-load point: Poisson arrivals for `duration_s`."""
+    """One offered-load point: Poisson arrivals for `duration_s`.
+    `system_prefixes` maps family -> shared prompt-prefix token list
+    prepended to every request (prompt_mix bounds the RANDOM TAIL)."""
     rng = random.Random(seed)
     results = []
     results_lock = threading.Lock()
@@ -218,16 +263,17 @@ def run_point(
         if delay > 0:
             time.sleep(delay)
         lo, hi = prompt_mix[rng.randrange(len(prompt_mix))]
-        prompt = [
+        tail = [
             rng.randrange(1, 100) for _ in range(rng.randint(lo, hi))
         ]
+        family = families[rng.randrange(len(families))]
+        prefix = list((system_prefixes or {}).get(family, ()))
         payload = {
-            "prompt": prompt,
+            "prompt": prefix + tail,
             "max_new_tokens": max_new_mix[
                 rng.randrange(len(max_new_mix))
             ],
         }
-        family = families[rng.randrange(len(families))]
         thread = threading.Thread(
             target=fire, args=(payload, family), daemon=True
         )
@@ -240,6 +286,10 @@ def run_point(
 
     done = [r for r in results if r.ok]
     errors = [r for r in results if not r.ok]
+    # SLO admission + proxy sheds (503 + Retry-After): counted
+    # separately from hard errors — a shed is the system WORKING
+    # under overload, not failing.
+    sheds = [r for r in errors if r.error.startswith("http 503")]
     window_end = max((r.end for r in done), default=time.perf_counter())
     wall = max(1e-9, window_end - t0)
     total_tokens = sum(r.tokens for r in done)
@@ -252,7 +302,8 @@ def run_point(
         "mix": sorted(set(families)),
         "requests": len(results),
         "completed": len(done),
-        "errors": len(errors),
+        "errors": len(errors) - len(sheds),
+        "shed": len(sheds),
         "error_sample": errors[0].error if errors else "",
         "tokens": total_tokens,
         "tokens_per_s": round(total_tokens / wall, 1),
@@ -277,11 +328,26 @@ def run_point(
             "families_seen": sorted(
                 {f for s in samples for f in s.get("families", [])}
             ),
+            "max_kv_blocks_used": max(
+                (s.get("kv_blocks_used", 0.0) for s in samples),
+                default=0.0,
+            ),
+            # Cumulative counters at the point's last sample (the
+            # trajectory across points shows the hit-rate ramp).
+            "prefix_hits": max(
+                (s.get("prefix_hits", 0.0) for s in samples),
+                default=0.0,
+            ),
+            "prefix_misses": max(
+                (s.get("prefix_misses", 0.0) for s in samples),
+                default=0.0,
+            ),
         },
     }
 
 
-def _deploy(families, engine_cfg, engine_enabled, version):
+def _deploy(families, engine_cfg, engine_enabled, version,
+            num_replicas=1):
     import ray_tpu.serve as serve
     from ray_tpu.llm import build_llm_app
 
@@ -289,6 +355,7 @@ def _deploy(families, engine_cfg, engine_enabled, version):
         families,
         engine=engine_cfg,
         engine_enabled=engine_enabled,
+        num_replicas=num_replicas,
         max_ongoing_requests=max(16, engine_cfg.get("slots", 4) * 4),
     )
     # Version forces a replica replacement on redeploy (engine -> a
@@ -297,28 +364,54 @@ def _deploy(families, engine_cfg, engine_enabled, version):
     return serve.run(app, name="llm", route_prefix="/llm")
 
 
-def _warm(port, families, prompt_mix, max_new_mix):
-    """One request per family per prompt-length BUCKET EDGE so every
-    jit compile (prefill bucket, slot insert, decode step) lands
-    outside the measured windows. Token budgets don't add shapes
-    (the engine's slot cache and the fallback's `cache_len` are both
-    fixed), so a 2-token budget keeps warmup fast."""
-    del max_new_mix
+def _system_prefixes(families, prefix_len):
+    """Deterministic per-family shared system prompt (the prefix-
+    cache workload: every request for a family starts with these
+    tokens, like a chat system prompt)."""
+    out = {}
+    for i, family in enumerate(sorted(families)):
+        rng = random.Random(1000 + i)
+        out[family] = [
+            rng.randrange(1, 100) for _ in range(prefix_len)
+        ]
+    return out
+
+
+def _warm(port, families, prompt_mix, system_prefixes, replicas=1):
+    """Warm requests per family per prompt-length BUCKET EDGE so
+    every jit compile lands outside the measured windows (the paged
+    engine compiles once per geometry, but the engine-off baseline
+    still compiles once per prefill bucket). With multiple replicas,
+    each edge fires a CONCURRENT wave of 2x replicas requests — the
+    least-outstanding-tokens router spreads a concurrent wave, so
+    every replica gets its compiles (and its prefix-cache seed) with
+    high probability; sequential warmups would all land on one idle
+    replica after another by tie-break luck."""
+    wave = max(1, 2 * replicas) if replicas > 1 else 1
     for family in families:
+        prefix = list(system_prefixes.get(family, ()))
         for edge in sorted({n for pair in prompt_mix for n in pair}):
-            result = _one_request(
-                port,
-                "/llm",
-                {
-                    "prompt": list(range(1, edge + 1)),
-                    "max_new_tokens": 2,
-                },
-                family,
-                timeout_s=600.0,
-            )
-            if not result.ok:
+            prompt = (prefix + list(range(1, edge + 1)))
+            results = []
+            threads = []
+
+            def fire():
+                results.append(_one_request(
+                    port, "/llm",
+                    {"prompt": prompt, "max_new_tokens": 2},
+                    family, timeout_s=600.0,
+                ))
+
+            for _ in range(wave):
+                t = threading.Thread(target=fire, daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=600.0)
+            if not any(r.ok for r in results):
                 raise RuntimeError(
-                    f"warmup failed for {family}: {result.error}"
+                    f"warmup failed for {family}: "
+                    f"{results[0].error if results else 'no result'}"
                 )
 
 
@@ -341,6 +434,11 @@ def run_bench(args) -> dict:
     }
     prompt_mix = ((4, 8), (12, 16)) if smoke else ((8, 16), (24, 48))
     max_new_mix = (8, 16) if smoke else (16, 32)
+    prefix_len = (
+        args.prefix_len if args.prefix_len is not None
+        else (16 if smoke else 32)
+    )
+    prefixes = _system_prefixes(families, prefix_len)
     # The top load must OVERSUBSCRIBE a single decode stream (arrival
     # rate x per-request service time > 1) or continuous batching has
     # nothing to batch — the measured smoke points sit above the
@@ -348,7 +446,11 @@ def run_bench(args) -> dict:
     loads = args.loads or ((8.0, 24.0) if smoke else (6.0, 14.0))
     duration = args.duration or (8.0 if smoke else 16.0)
 
-    rt.init()
+    # Replica actors each claim one LOGICAL cpu slot; declare enough
+    # for the --replicas pass (scheduling tokens, not cores — on a
+    # small box the replicas time-share, which is exactly the
+    # saturation behavior the bench measures).
+    rt.init(num_cpus=max(os.cpu_count() or 1, args.replicas))
     port = serve.start(http_port=0, per_node=False)
     route_key = "llm/llm"
     result = {
@@ -359,12 +461,13 @@ def run_bench(args) -> dict:
         "engine_config": engine_cfg,
         "loads_rps": list(loads),
         "duration_s": duration,
+        "prefix_len": prefix_len,
         "points": [],
         "baseline": [],
     }
     try:
         _deploy(families, engine_cfg, True, "engine-1")
-        _warm(port, list(families), prompt_mix, max_new_mix)
+        _warm(port, list(families), prompt_mix, prefixes)
         for i, load in enumerate(loads):
             # First point: single family. Later points: the full
             # multi-family mix (the multiplex-under-load case).
@@ -382,10 +485,12 @@ def run_bench(args) -> dict:
                     prompt_mix=prompt_mix,
                     max_new_mix=max_new_mix,
                     seed=100 + i,
+                    system_prefixes=prefixes,
                 )
             )
+        result["prefix"] = _prefix_totals()
 
-        # Engine series visible on the Prometheus exposition?
+        # Engine + prefix-cache series visible on the exposition?
         try:
             from ray_tpu.util.metrics import metrics_summary
             from ray_tpu.util.prometheus import render_prometheus
@@ -395,6 +500,10 @@ def run_bench(args) -> dict:
                 "prometheus_engine_series": (
                     "serve_engine_slots_used{" in text
                     and "serve_engine_step_batch_bucket{" in text
+                ),
+                "prometheus_prefix_series": (
+                    "serve_engine_prefix_hits_total{" in text
+                    and "serve_engine_kv_blocks_used{" in text
                 ),
                 "api_serve_engine": bool(
                     (
@@ -411,7 +520,7 @@ def run_bench(args) -> dict:
             # Same app, kill switch OFF: per-request generate_stream,
             # measured at the same top offered load + mix.
             _deploy(families, engine_cfg, False, "baseline-1")
-            _warm(port, list(families), prompt_mix, max_new_mix)
+            _warm(port, list(families), prompt_mix, prefixes)
             for i, load in enumerate(loads):
                 mix = ["tiny-a"] if i == 0 else list(families)
                 result["baseline"].append(
@@ -425,6 +534,7 @@ def run_bench(args) -> dict:
                         prompt_mix=prompt_mix,
                         max_new_mix=max_new_mix,
                         seed=100 + i,  # same arrival/length sequence
+                        system_prefixes=prefixes,
                     )
                 )
             top = result["points"][-1]
@@ -441,6 +551,73 @@ def run_bench(args) -> dict:
                 "engine_ttft_p99_ms": top["ttft_ms"]["p99"],
                 "baseline_ttft_p99_ms": base["ttft_ms"]["p99"],
             }
+
+        if args.replicas > 1:
+            # Horizontal-scale pass (ISSUE 11): same app + engine
+            # config, N replicas behind the same proxy; the router
+            # spreads by least-outstanding-tokens and SLO admission
+            # sheds (counted per point) once every replica's queue is
+            # over threshold.
+            _deploy(
+                families, engine_cfg, True,
+                f"engine-x{args.replicas}",
+                num_replicas=args.replicas,
+            )
+            _warm(
+                port, list(families), prompt_mix, prefixes,
+                replicas=args.replicas,
+            )
+            multi_loads = args.multi_loads or (
+                (12.0, 24.0) if smoke else (14.0, 24.0, 28.0)
+            )
+            multi_points = []
+            for i, load in enumerate(multi_loads):
+                multi_points.append(
+                    run_point(
+                        port=port,
+                        route="/llm",
+                        route_key=route_key,
+                        offered_rps=load,
+                        duration_s=duration,
+                        families=list(families),
+                        prompt_mix=prompt_mix,
+                        max_new_mix=max_new_mix,
+                        seed=200 + i,
+                        system_prefixes=prefixes,
+                    )
+                )
+            single_peak = max(
+                result["points"],
+                key=lambda p: p["achieved_rps"],
+            )
+            multi_peak = max(
+                multi_points, key=lambda p: p["achieved_rps"]
+            )
+            result["multi_replica"] = {
+                "replicas": args.replicas,
+                "loads_rps": list(multi_loads),
+                "points": multi_points,
+                "scaling": {
+                    "single_replica_peak_rps": (
+                        single_peak["achieved_rps"]
+                    ),
+                    "multi_replica_peak_rps": (
+                        multi_peak["achieved_rps"]
+                    ),
+                    "scale_factor": round(
+                        multi_peak["achieved_rps"]
+                        / max(1e-9, single_peak["achieved_rps"]),
+                        2,
+                    ),
+                    "single_ttft_p50_at_peak_ms": (
+                        single_peak["ttft_ms"]["p50"]
+                    ),
+                    "multi_ttft_p50_at_peak_ms": (
+                        multi_peak["ttft_ms"]["p50"]
+                    ),
+                },
+            }
+            result["prefix"] = _prefix_totals()
         result["value"] = result["points"][-1]["tokens_per_s"]
     finally:
         try:
@@ -474,6 +651,23 @@ def main() -> None:
     parser.add_argument(
         "--no-baseline", action="store_true",
         help="skip the engine-off comparison pass",
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=1,
+        help="run an extra horizontal-scale pass at this many "
+        "replicas (results under the 'multi_replica' key)",
+    )
+    parser.add_argument(
+        "--multi-loads",
+        type=lambda s: [float(x) for x in s.split(",")],
+        default=None,
+        help="offered-load points for the --replicas pass, req/s",
+    )
+    parser.add_argument(
+        "--prefix-len", type=int, default=None,
+        help="shared system-prompt tokens prepended to every "
+        "request per family (default 32, 16 with --smoke; 0 "
+        "disables the prefix workload)",
     )
     parser.add_argument(
         "--out", default=OUT_PATH,

@@ -1,0 +1,336 @@
+"""Paged-KV block allocator invariants (ISSUE 11): alloc/free/
+refcount, double-free detection, prefix pin/register/LRU-evict, and
+the engine-level memory contracts — a request the pool can never hold
+is SHED at submit, and a block-starved admission WAITS (FIFO, no
+crash, no skip-ahead) until running requests release their pages."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm.kv_slots import (
+    BlockAllocator,
+    BlocksExhausted,
+    PagedKVCache,
+    default_block_len,
+)
+
+
+# ---------------------------------------------------------------------
+# allocator invariants (pure bookkeeping, no jax)
+# ---------------------------------------------------------------------
+
+def test_reserve_release_roundtrip():
+    alloc = BlockAllocator(9)  # 8 usable + reserved null block
+    assert alloc.capacity() == 8
+    assert alloc.available() == 8
+    blocks = alloc.reserve(5)
+    assert len(set(blocks)) == 5
+    assert 0 not in blocks  # the null block is never handed out
+    assert alloc.used() == 5
+    assert alloc.available() == 3
+    alloc.release(blocks)
+    assert alloc.used() == 0
+    assert alloc.available() == 8
+
+
+def test_oom_raises_and_grants_nothing_partial():
+    alloc = BlockAllocator(5)
+    alloc.reserve(3)
+    avail = alloc.available()
+    with pytest.raises(BlocksExhausted):
+        alloc.reserve(avail + 1)
+    assert alloc.available() == avail  # all-or-nothing
+
+
+def test_double_free_raises():
+    alloc = BlockAllocator(4)
+    blocks = alloc.reserve(1)
+    alloc.release(blocks)
+    with pytest.raises(ValueError):
+        alloc.release(blocks)
+
+
+def test_refcount_shared_prefix_block():
+    alloc = BlockAllocator(8)
+    [block] = alloc.reserve(1)
+    alloc.register(block, ("p",))
+    # A second request pins the same prefix block.
+    assert alloc.match_prefix([("p",)]) == [block]
+    alloc.release([block])  # first owner done
+    assert alloc.used() == 1  # still pinned by the second
+    alloc.release([block])  # second owner done
+    assert alloc.used() == 0
+    assert alloc.cached() == 1  # refcount 0 but reusable
+    # Still matchable from the cached-free state (re-pins it).
+    assert alloc.match_prefix([("p",)]) == [block]
+    alloc.release([block])
+
+
+def test_eviction_is_lru_and_drops_prefix_entry():
+    alloc = BlockAllocator(3)  # 2 usable
+    a, b = alloc.reserve(2)
+    alloc.register(a, ("a",))
+    alloc.register(b, ("b",))
+    alloc.release([a])  # a becomes cached-free first (older)
+    alloc.release([b])
+    [evicted] = alloc.reserve(1)
+    assert evicted == a  # oldest cached-free evicts first
+    assert alloc.peek_prefix([("a",)]) == 0  # its prefix entry is gone
+    assert alloc.peek_prefix([("b",)]) == 1  # the newer one survives
+
+
+def test_match_pins_block_out_of_eviction():
+    alloc = BlockAllocator(3)
+    a, b = alloc.reserve(2)
+    alloc.register(a, ("a",))
+    alloc.register(b, ("b",))
+    alloc.release([a])
+    alloc.release([b])
+    assert alloc.match_prefix([("a",)]) == [a]  # pin a
+    [evicted] = alloc.reserve(1)
+    assert evicted == b  # the reservation cannot steal the pinned hit
+    alloc.release([a])
+
+
+def test_register_first_writer_wins_and_requires_pin():
+    alloc = BlockAllocator(4)
+    a, b = alloc.reserve(2)
+    assert alloc.register(a, ("k",)) is True
+    assert alloc.register(b, ("k",)) is False  # prefix taken: no-op
+    assert alloc.match_prefix([("k",)]) == [a]
+    alloc.release([a])
+    with pytest.raises(ValueError):
+        alloc.register(99, ("other",))  # unpinned block
+
+
+def test_peek_prefix_stops_at_first_gap():
+    alloc = BlockAllocator(8)
+    a, b = alloc.reserve(2)
+    alloc.register(a, ("p1",))
+    alloc.register(b, ("p3",))
+    assert alloc.peek_prefix([("p1",), ("p2",), ("p3",)]) == 1
+    assert alloc.match_prefix([("p1",), ("p2",), ("p3",)]) == [a]
+    alloc.release([a])  # the match pin
+    alloc.release([a, b])  # the original reservations
+
+
+# ---------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------
+
+def test_default_block_len_divides_chunk():
+    assert default_block_len(32) == 16
+    assert default_block_len(8) == 8
+    assert default_block_len(24) == 12
+    assert default_block_len(7) == 7
+    for chunk in (7, 8, 16, 24, 32, 48):
+        assert chunk % default_block_len(chunk) == 0
+
+
+def test_paged_cache_geometry_validation():
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32, dim=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        intermediate=32, max_seq_len=64, dtype=jnp.float32,
+        attention="reference",
+    )
+    with pytest.raises(ValueError):  # block doesn't divide chunk
+        PagedKVCache(cfg, 8, 16, 64, prefill_chunk=8)
+    with pytest.raises(ValueError):  # max_len not a block multiple
+        PagedKVCache(cfg, 8, 8, 60, prefill_chunk=8)
+    kv = PagedKVCache(cfg, 8, 8, 64, prefill_chunk=8)
+    assert kv.max_blocks == 8
+    assert kv.blocks_for(1) == 1
+    assert kv.blocks_for(8) == 1
+    assert kv.blocks_for(9) == 2
+
+
+def test_prefix_keys_cover_only_full_blocks_and_bind_whole_prefix():
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32, dim=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        intermediate=32, max_seq_len=64, dtype=jnp.float32,
+        attention="reference",
+    )
+    kv = PagedKVCache(cfg, 8, 8, 64, prefill_chunk=8)
+    prompt = list(range(20))  # 2 full blocks + 4-token partial
+    keys = kv.prefix_keys(prompt)
+    assert len(keys) == 2  # the partial block never gets a key
+    # Deterministic, and equal prefixes produce equal keys.
+    assert keys == kv.prefix_keys(prompt[:17])
+    # The chain binds the WHOLE prefix: same second block behind a
+    # different first block must yield a different second key.
+    other = kv.prefix_keys([99] + list(range(1, 20)))
+    assert other[0] != keys[0]
+    assert other[1] != keys[1]
+    # Shared first block, divergent second.
+    branch = kv.prefix_keys(list(range(8)) + [77] * 8)
+    assert branch[0] == keys[0]
+    assert branch[1] != keys[1]
+
+
+# ---------------------------------------------------------------------
+# engine-level memory contracts (tiny model)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        intermediate=128, max_seq_len=128, dtype=jnp.float32,
+        attention="reference",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_engine_pool_oom_sheds_at_submit(tiny_model):
+    """A request that could NEVER get its pages (bigger than the whole
+    pool) is shed at submit with EngineOverloaded; the engine stays
+    alive and keeps serving pool-sized requests."""
+    from ray_tpu.llm import (
+        EngineConfig, EngineOverloaded, InferenceEngine,
+    )
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(
+            slots=2, max_len=48, prefill_chunk=8, kv_blocks=4,
+            max_new_tokens=8,
+        ),
+        family="tiny",
+    )
+    try:
+        # 29-token prompt + 8 budget = 37 tokens = 5 blocks of 8, but
+        # the pool only holds 3 usable blocks.
+        with pytest.raises(EngineOverloaded):
+            eng.submit(list(range(1, 30)), max_new_tokens=8)
+        out = list(eng.submit([1, 2, 3], max_new_tokens=4))
+        assert len(out) == 4
+        assert eng.stats()["dead"] is False
+    finally:
+        eng.close()
+
+
+def test_block_starved_admission_waits_then_serves(tiny_model):
+    """Two requests that each need more than half the pool: slots are
+    free but blocks are not, so the second request WAITS (gated FIFO
+    admission) and is served after the first releases its pages —
+    never a reserve failure that would kill the loop."""
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(
+            slots=2, max_len=48, prefill_chunk=8, kv_blocks=7,
+            max_new_tokens=16, prefix_cache=False,
+        ),
+        family="tiny",
+    )
+    try:
+        # Each needs ceil((16 + 16) / 8) = 4 of the 6 usable blocks.
+        first = eng.submit(list(range(1, 17)), max_new_tokens=16)
+        second = eng.submit(list(range(101, 117)), max_new_tokens=16)
+        assert len(list(first)) == 16
+        assert len(list(second)) == 16
+        stats = eng.stats()
+        assert stats["dead"] is False
+        assert stats["kv_blocks_used"] == 0  # everything released
+    finally:
+        eng.close()
+
+
+def test_peek_cached_distinguishes_live_pins_from_cached_free():
+    alloc = BlockAllocator(8)
+    a, b = alloc.reserve(2)
+    alloc.register(a, ("p1",))
+    alloc.register(b, ("p2",))
+    alloc.release([b])  # b cached-free; a stays live-pinned
+    assert alloc.peek_cached([("p1",), ("p2",)], 2) == 1
+    assert alloc.peek_cached([("p1",), ("p2",)], 1) == 0  # a is live
+    alloc.release([a])
+
+
+def test_sharing_live_prefix_relaxes_admission(tiny_model):
+    """Review-caught gate bug: hit blocks pinned by a LIVE request
+    cost no availability to share, so a prefix-sharing request must
+    fit in a pool the naive `available >= total` arithmetic says is
+    full — both requests decode CONCURRENTLY."""
+    import threading
+
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(
+            slots=2, max_len=48, prefill_chunk=8, kv_blocks=8,
+            max_new_tokens=8, prefix_cache=True,
+        ),
+        family="tiny",
+    )
+    try:
+        shared = list(range(1, 17))  # 2 full blocks
+        # A: 5 of the 7 usable blocks (16 prompt + 24 budget).
+        first = eng.submit(shared, max_new_tokens=24)
+        consumed = []
+        consumer = threading.Thread(
+            target=lambda: consumed.extend(first), daemon=True
+        )
+        consumer.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and not consumed:
+            time.sleep(0.005)  # A is decoding (prefix registered)
+        # B: identical prompt, 3 total blocks, skip 1 shared block ->
+        # needs 2 fresh of the 2 still available. Old gate demanded 3.
+        second = eng.submit(shared, max_new_tokens=8)
+        concurrent = False
+        while time.time() < deadline:
+            stats = eng.stats()
+            if stats["slots_used"] == 2:
+                concurrent = True
+                break
+            time.sleep(0.005)
+        assert concurrent, "prefix-sharing request was not admitted " \
+            "while the prefix owner was still decoding"
+        assert len(list(second)) == 8
+        consumer.join(timeout=30)
+        assert len(consumed) == 24
+        assert eng.stats()["prefix_hits"] >= 1
+    finally:
+        eng.close()
+
+
+def test_engine_block_accounting_in_stats(tiny_model):
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(slots=2, max_len=48, prefill_chunk=8,
+                     max_new_tokens=4),
+        family="tiny",
+    )
+    try:
+        stats = eng.stats()
+        assert stats["kv_block_len"] == 8
+        assert stats["kv_blocks_total"] == 2 * (48 // 8)
+        assert stats["kv_blocks_used"] == 0
+        list(eng.submit([5, 6, 7], max_new_tokens=4))
+        stats = eng.stats()
+        assert stats["kv_blocks_used"] == 0
+        # The full prompt had no full block (3 tokens < 8), so
+        # nothing registers in the prefix cache either.
+        assert stats["kv_blocks_cached"] == 0
+    finally:
+        eng.close()

@@ -119,6 +119,97 @@ def test_engine_matches_generate_greedy(tiny_model, engine):
         assert out == np.asarray(ref)[0].tolist()
 
 
+def test_prefix_hit_parity_with_generate(tiny_model):
+    """ISSUE 11 satellite: with the paged cache AND prefix caching ON,
+    a request whose prompt prefix hits the pool must skip prefill for
+    the shared blocks and STILL decode token-for-token what
+    `generate()` produces — including a request that shares only the
+    prefix, not the whole prompt."""
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+    from ray_tpu.models.generate import generate
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(max_new_tokens=8, prefix_cache=True, **ENGINE_KW),
+        family="tiny",
+    )
+    try:
+        rng = np.random.default_rng(21)
+        base = rng.integers(1, 128, size=20).tolist()
+        prompts = [
+            base,  # seeds the prefix cache (miss)
+            list(base),  # identical prompt: full-prefix hit
+            base[:16] + rng.integers(1, 128, size=5).tolist(),
+            # ^ shares only the first two blocks (16 tokens)
+        ]
+        outs = []
+        for prompt in prompts:
+            stream = eng.submit(prompt, max_new_tokens=8)
+            outs.append(list(stream))
+            assert stream.finish_reason == "length"
+        stats = eng.stats()
+        # Prompt 1 missed; prompts 2 and 3 hit (block_len=8: two full
+        # blocks of `base` are cached, and skip is chunk-aligned at
+        # 16 tokens for both).
+        assert stats["prefix_misses"] >= 1
+        assert stats["prefix_hits"] == 2
+        assert stats["prefix_tokens_saved"] == 32
+        for prompt, out in zip(prompts, outs):
+            ref, _ = generate(
+                params,
+                jnp.asarray([prompt], jnp.int32),
+                jnp.asarray([len(prompt)], jnp.int32),
+                cfg, max_new_tokens=8, temperature=0.0,
+            )
+            assert out == np.asarray(ref)[0].tolist()
+    finally:
+        eng.close()
+
+
+def test_midprefill_row_not_corrupted_by_interleaved_decode(
+    tiny_model,
+):
+    """Review-caught paged-cache corruption: while a request CHUNK-
+    PREFILLS, its block table is already built but its row is not yet
+    alive — the interleaved decode step over the full slot batch must
+    NOT scatter its junk row (stale position, masked token) into the
+    request's real pages. Pre-fix, a slot whose previous occupant
+    finished at a low position wrote junk INSIDE the new prompt's
+    already-prefilled region (position 0 here), and the output
+    diverged from generate()."""
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+    from ray_tpu.models.generate import generate
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(
+        params, cfg,
+        EngineConfig(max_new_tokens=8, prefix_cache=False,
+                     **ENGINE_KW),
+        family="tiny",
+    )
+    try:
+        # Keep the decode batch hot so every prefill chunk of the
+        # long request interleaves with a decode step.
+        busy = eng.submit([9, 9, 9, 9], max_new_tokens=30)
+        assert isinstance(next(busy), int)
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(1, 128, size=20).tolist()  # 3 chunks
+        stream = eng.submit(prompt, max_new_tokens=8)
+        out = list(stream)
+        busy.cancel()
+        list(busy)
+        ref, _ = generate(
+            params,
+            jnp.asarray([prompt], jnp.int32),
+            jnp.asarray([len(prompt)], jnp.int32),
+            cfg, max_new_tokens=8, temperature=0.0,
+        )
+        assert out == np.asarray(ref)[0].tolist()
+    finally:
+        eng.close()
+
+
 def test_engine_eos_stops_row(tiny_model, engine):
     from ray_tpu.models.generate import generate
 
@@ -300,7 +391,7 @@ def test_engine_death_fails_inflight_not_hangs(tiny_model):
     )
     live = eng.submit([1, 2, 3, 4])
     assert len(list(live)) == 8  # engine is healthy
-    eng._kv.cache = None  # chaos: corrupt the loop's device state
+    eng._kv.pool = None  # chaos: corrupt the loop's device state
     doomed = eng.submit([5, 6, 7, 8])
     with pytest.raises(EngineDead):
         list(doomed)  # the step loop died on this request

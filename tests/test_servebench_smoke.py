@@ -74,3 +74,59 @@ def test_servebench_smoke_emits_composite_json(tmp_path):
     cmp = out["comparison"]
     assert cmp["engine_tokens_per_s"] > cmp["baseline_tokens_per_s"]
     assert cmp["speedup"] > 1.0
+
+    # Paged-KV + prefix-cache visibility (ISSUE 11): the shared
+    # system-prefix workload must actually HIT the prefix cache, and
+    # the new series must render on the Prometheus exposition.
+    assert out["prefix"]["hits"] > 0
+    assert out["prefix"]["tokens_saved"] > 0
+    assert out["metrics_visible"]["prometheus_prefix_series"] is True
+
+
+# slow: ~3 min — the multi-replica pass redeploys at 2 replicas and
+# runs the scale loads on top of the single-replica points.
+@pytest.mark.slow
+@pytest.mark.timeout(560)
+def test_servebench_smoke_multi_replica(tmp_path):
+    """ISSUE 11 CI satellite: >=2 replicas on CPU through the full
+    proxy -> least-outstanding-tokens router -> replica -> paged
+    engine path; prefix-hit counter > 0 and the new Prometheus series
+    parse (text-format sanity via the repo's own renderer checks)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out_path = str(tmp_path / "SERVEBENCH.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "servebench.py"),
+            "--smoke",
+            "--replicas", "2",
+            "--no-baseline",
+            "--out", out_path,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [
+        ln for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+
+    multi = out["multi_replica"]
+    assert multi["replicas"] == 2
+    assert len(multi["points"]) >= 2
+    for point in multi["points"]:
+        assert point["completed"] > 0
+        assert point["tokens_per_s"] > 0
+        assert "shed" in point  # sheds counted per point
+    assert multi["scaling"]["multi_replica_peak_rps"] > 0
+
+    # Prefix caching engaged across the run and is exposition-visible.
+    assert out["prefix"]["hits"] > 0
+    assert out["metrics_visible"]["prometheus_prefix_series"] is True
+    assert out["metrics_visible"]["prometheus_engine_series"] is True
