@@ -208,6 +208,21 @@ class Config:
     #: full-path token rate this bounds worst-case time-to-first-token
     #: for admitted requests).
     serve_slo_queue_threshold_tokens: int = 1024
+    #: MPMD pipeline training (train/mpmd_pipeline.py): records a
+    #: channel edge buffers before put() blocks the producer — the
+    #: pipeline's backpressure bound (channel capacity = depth x
+    #: microbatch-activation record size). 1F1B needs only ~2 in
+    #: flight per edge in steady state; extra depth absorbs stage
+    #: jitter without letting a fast stage run unboundedly ahead.
+    pipeline_channel_depth: int = 4
+    #: Per-hop channel put/get timeout inside a pipeline stage. A
+    #: stage blocked longer than this fails the step (the driver
+    #: additionally closes all edges on ANY stage failure so peers
+    #: unblock immediately rather than waiting this out).
+    pipeline_hop_timeout_s: float = 120.0
+    #: End-to-end bound on one MPMDPipeline.step(): the driver aborts
+    #: (closing every edge) and raises rather than hang past it.
+    pipeline_step_timeout_s: float = 600.0
 
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`

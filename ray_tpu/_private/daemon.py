@@ -4621,6 +4621,78 @@ class NodeDaemon:
             out["buckets"] = named
         return out
 
+    def _dag_edge_summary(self) -> dict:
+        """Per-edge channel counters for the doctor verdict: for each
+        dag/edges.py edge seen by the head, total hops + bytes (summed
+        over directions) and send/recv wait percentiles from the
+        histogram reservoirs. ``suspect`` names the edge whose
+        consumer waits longest at p50 (>= 1 ms and >= 2 edges) — in a
+        pipeline that points at the producing stage. Only
+        driver-paced pipeline streams (dir fwd/grad) are eligible: a
+        compiled-DAG exec loop's input get (dir "dag") also spans
+        idle time between execute() calls, which would convict
+        healthy stages of merely idle DAGs."""
+        edges: dict = {}
+        paced: set = set()
+        with self._lock:
+            for name, field in (
+                ("dag_channel_hops_total", "hops"),
+                ("dag_channel_bytes_total", "bytes"),
+            ):
+                entry = self._metrics_table.get(name)
+                if not entry:
+                    continue
+                for tags, bucket in entry["by_tags"].items():
+                    edge = dict(tags).get("edge")
+                    if edge is None:
+                        continue
+                    row = edges.setdefault(edge, {})
+                    row[field] = row.get(field, 0) + int(
+                        bucket.get("total", 0)
+                    )
+            for name, field in (
+                ("dag_channel_send_wait_ms", "send_wait_ms"),
+                ("dag_channel_recv_wait_ms", "recv_wait_ms"),
+            ):
+                entry = self._metrics_table.get(name)
+                if not entry:
+                    continue
+                boundaries = entry.get("boundaries", ())
+                for tags, bucket in entry["by_tags"].items():
+                    tag_map = dict(tags)
+                    edge = tag_map.get("edge")
+                    if edge is None:
+                        continue
+                    if tag_map.get("dir") in ("fwd", "grad"):
+                        paced.add(edge)
+                    hist = self._finish_histogram(bucket, boundaries)
+                    edges.setdefault(edge, {})[field] = {
+                        k: hist[k]
+                        for k in ("count", "sum", "p50", "p99", "max")
+                        if k in hist
+                    }
+        if not edges:
+            return {}
+        out: dict = {"edges": edges}
+        waits = [
+            (row.get("recv_wait_ms", {}).get("p50", 0.0), edge)
+            for edge, row in edges.items()
+            if edge in paced
+        ]
+        waits.sort(reverse=True)
+        if len(waits) >= 2 and waits[0][0] >= 1.0:
+            p50, edge = waits[0]
+            out["suspect"] = {
+                "edge": edge,
+                "recv_wait_p50_ms": p50,
+                "detail": (
+                    f"edge {edge}: consumer median recv wait "
+                    f"{p50:.1f} ms — the producing side is the "
+                    "slowest stage of this DAG/pipeline"
+                ),
+            }
+        return out
+
     def _h_metrics_summary(self, conn, msg):
         if not self.is_head:
             return self.head.call("metrics_summary")
@@ -5016,6 +5088,13 @@ class NodeDaemon:
         # Per-job goodput classification over the same window the
         # straggler stats use, so both surfaces describe one cluster.
         steps["goodput"] = goodput_from_records(step_records)
+
+        # Compiled-DAG / MPMD-pipeline channel edges: fold the
+        # dag_channel_* metrics (dag/edges.py) into per-edge rows so
+        # a straggler STAGE is named like a straggler rank — the edge
+        # whose consumer sits longest in recv names its PRODUCER as
+        # the slow side.
+        dag = self._dag_edge_summary()
         workers = steps.get("workers", {})
         if len(workers) >= 2:
             medians = sorted(
@@ -5254,6 +5333,7 @@ class NodeDaemon:
                 "healthy": not problems,
                 "problems": problems,
                 "steps": steps,
+                "dag": dag,
                 "rpc": ring_digests,
                 "nodes": {
                     "total": summary["nodes"],

@@ -140,8 +140,17 @@ def timed_iter(
 
 
 #: Phase layout order inside a step slice: the waits the loop paid
-#: before/around the step, then the step itself.
-_TRACE_PHASES = ("data_wait_ms", "h2d_ms", "ckpt_block_ms", "step_ms")
+#: before/around the step, then the step itself. send/recv wait are
+#: the MPMD pipeline's channel-blocked time (dag/edges.py bills them)
+#: — the per-stage bubble attribution the pipeline doctor reads.
+_TRACE_PHASES = (
+    "data_wait_ms",
+    "h2d_ms",
+    "ckpt_block_ms",
+    "send_wait_ms",
+    "recv_wait_ms",
+    "step_ms",
+)
 
 
 def steps_to_chrome_trace(records) -> list:
@@ -214,7 +223,15 @@ def steps_to_chrome_trace(records) -> list:
 
 
 #: Wait phases that classify as stall time in goodput accounting.
-_STALL_PHASES = ("data_wait_ms", "h2d_ms", "ckpt_block_ms")
+#: send/recv wait are pipeline-channel blocked time: for an MPMD
+#: stage, that IS the (bubble + transport) share of its wall.
+_STALL_PHASES = (
+    "data_wait_ms",
+    "h2d_ms",
+    "ckpt_block_ms",
+    "send_wait_ms",
+    "recv_wait_ms",
+)
 
 
 def goodput_from_records(records) -> Dict[str, dict]:
@@ -354,9 +371,7 @@ def report_step(
         step_ms = max(
             0.0,
             float(wall_ms)
-            - phases.get("data_wait_ms", 0.0)
-            - phases.get("h2d_ms", 0.0)
-            - phases.get("ckpt_block_ms", 0.0),
+            - sum(phases.get(p, 0.0) for p in _STALL_PHASES),
         )
     try:
         record["step_ms"] = round(float(step_ms or 0.0), 3)

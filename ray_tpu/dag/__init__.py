@@ -2,6 +2,7 @@
 
 from .channels import ShmChannel
 from .compiled import CompiledDAG, CompiledDAGRef
+from .edges import Edge
 from .dag_node import (
     ClassMethodNode,
     DAGNode,
@@ -22,5 +23,6 @@ __all__ = [
     "CompiledDAG",
     "CompiledDAGRef",
     "ShmChannel",
+    "Edge",
     "experimental_compile",
 ]

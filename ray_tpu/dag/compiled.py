@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..actor import ActorMethod
 from .channels import ChannelTimeoutError, ShmChannel
+from .edges import Edge
 from .tcp_channel import TcpChannel
 from .dag_node import (
     ClassMethodNode,
@@ -34,6 +35,39 @@ from .dag_node import (
 _WHOLE = object()
 
 DAG_LOOP_METHOD = "__rt_dag_loop__"
+
+
+def wait_actor_placements(
+    actor_handles, timeout: float = 30.0
+) -> Dict[bytes, Optional[str]]:
+    """actor_id bytes -> node_id hex for every handle, polling the
+    control plane until each actor has been placed (a just-created
+    actor may still be leasing a worker). Shared by compiled-DAG
+    channel wiring and the MPMD pipeline's edge placement — both need
+    the same-node-or-not decision per edge."""
+    from .._private.worker import global_worker
+
+    worker = global_worker()
+    want = {h.actor_id.binary() for h in actor_handles}
+    deadline = time.monotonic() + timeout
+    placement: Dict[bytes, Optional[str]] = {}
+    while True:
+        rows = worker.call("list_actors")["actors"]
+        placement = {
+            bytes.fromhex(row["actor_id"]): row["node_id"]
+            for row in rows
+            if bytes.fromhex(row["actor_id"]) in want
+        }
+        if len(placement) == len(want) and all(
+            v is not None for v in placement.values()
+        ):
+            return placement
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                "actors not placed within "
+                f"{timeout}s (have {len(placement)}/{len(want)})"
+            )
+        time.sleep(0.05)
 
 
 def dag_exec_loop(
@@ -184,6 +218,12 @@ class CompiledDAG:
         out_chans: Dict[int, List[ShmChannel]] = {
             id(n): [] for n in actor_nodes
         }
+        def label(n: ClassMethodNode) -> str:
+            return (
+                f"{n.method_name}@"
+                f"{n.actor_handle.actor_id.hex()[:6]}"
+            )
+
         for node in actor_nodes:
             descs: List[Tuple[str, Any]] = []
             node_placement = placement[node.actor_handle.actor_id.binary()]
@@ -195,13 +235,37 @@ class CompiledDAG:
                         if isinstance(arg, InputAttributeNode)
                         else _WHOLE
                     )
-                    self._input_channels.append((chan, key))
-                    descs.append(("chan", chan))
+                    # Edges wrap the raw channel with per-edge
+                    # counters (hops/bytes/wait histograms on the
+                    # metrics pipe; doctor folds them) — the channel
+                    # itself stays in _all_channels for teardown.
+                    # Driver IO edges are counters-only (timed=False):
+                    # their blocked time is the caller's own
+                    # execute()/get() latency, and the ~2 us timed
+                    # path would tax the ~25 us hop (MICROBENCH
+                    # dag_hop_per_s). Actor->actor edges keep full
+                    # wait timing — that's where a straggler stage
+                    # shows.
+                    edge = Edge(
+                        chan, f"driver->{label(node)}", "in",
+                        timed=False,
+                    )
+                    self._input_channels.append((edge, key))
+                    descs.append(("chan", edge))
                 elif isinstance(arg, ClassMethodNode):
                     src = placement[arg.actor_handle.actor_id.binary()]
                     chan = self._new_channel(src, node_placement)
-                    out_chans[id(arg)].append(chan)
-                    descs.append(("chan", chan))
+                    # Direction "dag" (not the pipeline's
+                    # "fwd"/"grad"): an exec loop's blocking input
+                    # get also spans IDLE time between execute()
+                    # calls, so these waits must not feed the
+                    # doctor's straggler-stage heuristic — only the
+                    # driver-paced pipeline streams do.
+                    edge = Edge(
+                        chan, f"{label(arg)}->{label(node)}", "dag"
+                    )
+                    out_chans[id(arg)].append(edge)
+                    descs.append(("chan", edge))
                 elif isinstance(arg, DAGNode):
                     raise TypeError(
                         f"unsupported arg node {type(arg).__name__}"
@@ -223,8 +287,11 @@ class CompiledDAG:
                 # (teardown-without-get must not wedge the exec loop
                 # in rendezvous).
                 chan.bind_reader()
-            self._output_channels.append(chan)
-            out_chans[id(out)].append(chan)
+            edge = Edge(
+                chan, f"{label(out)}->driver", "out", timed=False
+            )
+            self._output_channels.append(edge)
+            out_chans[id(out)].append(edge)
 
         # Start one persistent loop per actor.
         for node in actor_nodes:
@@ -255,32 +322,9 @@ class CompiledDAG:
 
     @staticmethod
     def _actor_placements(actor_nodes, timeout: float = 30.0):
-        """actor_id -> node_id hex for every DAG actor, polling the
-        control plane until each actor has been placed (a just-created
-        actor may still be leasing a worker)."""
-        from .._private.worker import global_worker
-
-        worker = global_worker()
-        want = {n.actor_handle.actor_id.binary() for n in actor_nodes}
-        deadline = time.monotonic() + timeout
-        placement: Dict[bytes, Optional[str]] = {}
-        while True:
-            rows = worker.call("list_actors")["actors"]
-            placement = {
-                bytes.fromhex(row["actor_id"]): row["node_id"]
-                for row in rows
-                if bytes.fromhex(row["actor_id"]) in want
-            }
-            if len(placement) == len(want) and all(
-                v is not None for v in placement.values()
-            ):
-                return placement
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    "compiled DAG: actors not placed within "
-                    f"{timeout}s (have {len(placement)}/{len(want)})"
-                )
-            time.sleep(0.05)
+        return wait_actor_placements(
+            [n.actor_handle for n in actor_nodes], timeout=timeout
+        )
 
     # -- execution -----------------------------------------------------
     def execute(

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.collective import axis_size as _axis_size
+
 
 def init_moe_params(
     key,
@@ -128,7 +130,7 @@ def moe_ffn_ep(
     one all_to_all sends each rank's per-expert buffers to the expert's
     owner; experts run dense; a second all_to_all returns outputs.
     """
-    ep = lax.axis_size(axis_name)
+    ep = _axis_size(axis_name)
     e_local = params["w_in"].shape[0]
     num_experts = e_local * ep
     t_local, d = x.shape

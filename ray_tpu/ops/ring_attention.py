@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.collective import axis_size as _axis_size
+
 from .attention import DEFAULT_MASK_VALUE
 
 
@@ -42,7 +44,7 @@ def ring_attention(
     """
     b, h, t_local, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
 
     qf = q.astype(jnp.float32)
@@ -126,7 +128,7 @@ def ulysses_attention(
     attention_fn = attention_fn or (
         lambda q, k, v: mha_reference(q, k, v, causal=causal, scale=scale)
     )
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
 
     def reshard_to_heads(x):
         # [b, H, t/n, d] -> [b, H/n, t, d]: split heads, concat seq.

@@ -1,7 +1,7 @@
 """Parallelism layer: named meshes, logical sharding rules, and an
 explicit collective API that compiles to XLA/ICI collectives."""
 
-from . import collective
+from . import collective, schedule
 from .mesh import (
     AXES,
     MeshSpec,
@@ -30,6 +30,7 @@ __all__ = [
     "data_axes",
     "model_axes",
     "collective",
+    "schedule",
     "ACT_RULES",
     "PARAM_RULES",
     "Annotated",

@@ -59,7 +59,7 @@ def reducescatter(x, axis: Axis, *, scatter_axis: int = 0, op: str = "sum"):
         raise ValueError(f"unsupported reducescatter op: {op}")
     out = lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
     if op == "mean":
-        out = out / lax.axis_size(axis)
+        out = out / axis_size(axis)
     return out
 
 
@@ -80,7 +80,7 @@ def send_recv(x, axis: Axis, *, shift: int = 1):
     `shift` steps forward and receives from `shift` steps back
     (reference p2p: collective.py:531 send / :594 recv; here a single
     fused ppermute, which is how rings ride ICI)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -111,4 +111,25 @@ def axis_index(axis: Axis):
 
 
 def axis_size(axis: Axis):
-    return lax.axis_size(axis)
+    """Static size of a named mesh axis, on any jax this repo meets:
+    `lax.axis_size` where it exists (>= 0.6), else `psum(1, axis)` —
+    which constant-folds to the same Python int at trace time."""
+    try:
+        return lax.axis_size(axis)
+    except AttributeError:
+        return lax.psum(1, axis)
+
+
+def pcast_varying(x, axes):
+    """Mark `x` varying over `axes` for jax >= 0.7's
+    varying-manual-axes type check; a no-op per axis when the axis is
+    already varying or the jax predates `lax.pcast` (same guard idiom
+    as ops/ring_attention._varying)."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    for ax in axes:
+        try:
+            x = lax.pcast(x, (ax,), to="varying")
+        except (AttributeError, TypeError, ValueError):
+            pass
+    return x

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collective import axis_size as _axis_size, pcast_varying
+
 
 def spmd_pipeline(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -56,7 +58,7 @@ def spmd_pipeline(
     """
     if stacked_params:
         stage_params = jax.tree.map(lambda a: a[0], stage_params)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     num_mb = microbatches.shape[0]
     ticks = num_mb + n - 1
@@ -93,22 +95,16 @@ def spmd_pipeline(
 
     # The carry is device-varying over pp (each rank holds different
     # activations); mark the zero initializers so scan's type check
-    # agrees (jax >= 0.7 varying-manual-axes). zeros_like inherits any
-    # OTHER varying axes (sp/ep) the activations already carry when the
-    # pipeline composes with sequence/expert parallelism.
-    state = lax.pcast(
+    # agrees (jax >= 0.7 varying-manual-axes; a no-op on older jax
+    # without pcast). zeros_like inherits any OTHER varying axes
+    # (sp/ep) the activations already carry when the pipeline composes
+    # with sequence/expert parallelism.
+    state = pcast_varying(
         jnp.zeros_like(jnp.take(microbatches, 0, axis=0)),
-        (axis_name,),
-        to="varying",
+        axis_name,
     )
-    outputs = lax.pcast(
-        jnp.zeros_like(microbatches),
-        (axis_name,),
-        to="varying",
-    )
-    aux_acc = lax.pcast(
-        jnp.zeros((), jnp.float32), (axis_name,), to="varying"
-    )
+    outputs = pcast_varying(jnp.zeros_like(microbatches), axis_name)
+    aux_acc = pcast_varying(jnp.zeros((), jnp.float32), axis_name)
     _, outputs, aux_acc = lax.fori_loop(
         0, ticks, tick, (state, outputs, aux_acc)
     )

@@ -586,6 +586,12 @@ def cmd_doctor(args) -> None:
         f"(workers reporting: {len(steps.get('workers', {}))}, "
         f"max gang skew: {steps.get('max_skew_ms', 0.0):g} ms)"
     )
+    dag = verdict.get("dag") or {}
+    if dag.get("edges"):
+        print(f"dag edges instrumented: {len(dag['edges'])}")
+        suspect = dag.get("suspect")
+        if suspect:
+            print(f"  {suspect['detail']}")
     if verdict.get("healthy"):
         print("verdict: HEALTHY")
         return

@@ -32,6 +32,7 @@ from .train_step import (
     prefetch_to_device,
     shard_batch,
 )
+from .mpmd_pipeline import MPMDPipeline, MPMDPipelineError
 from .trainer import JaxTrainer
 from .worker_group import WorkerGroup
 
@@ -47,6 +48,8 @@ __all__ = [
     "JaxBackend",
     "CpuTestBackend",
     "WorkerGroup",
+    "MPMDPipeline",
+    "MPMDPipelineError",
     "TrainState",
     "make_train_step",
     "default_optimizer",

@@ -27,11 +27,6 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
-
 from ..models.llama import (
     LlamaConfig,
     _layer,
@@ -42,19 +37,17 @@ from ..models.llama import (
 )
 from ..ops.norms import rotary_embedding
 from ..parallel.pipeline import broadcast_from_last_stage, spmd_pipeline
-from ..parallel.sharding import Annotated
+from ..parallel.sharding import Annotated, checked_shard_map
 from .train_step import TrainState, infer_opt_shardings
 
 
 def _promote(x, axes):
-    """Mark x varying over `axes` (no-op per axis when already so) —
-    required before psum/pmean under jax's varying-manual-axes check."""
-    for ax in axes:
-        try:
-            x = lax.pcast(x, (ax,), to="varying")
-        except ValueError:
-            pass
-    return x
+    """Mark x varying over `axes` (no-op per axis when already so, or
+    on a jax predating pcast) — required before psum/pmean under
+    jax >= 0.7's varying-manual-axes check."""
+    from ..parallel.collective import pcast_varying
+
+    return pcast_varying(x, axes)
 
 
 def to_pipeline_params(params: Any, pp: int) -> Any:
@@ -192,11 +185,11 @@ def make_pp_train_step(
         xent = local[0] / jnp.maximum(local[1], 1.0)
         return xent + cfg.moe_aux_weight * aux
 
-    smapped = shard_map(
+    smapped = checked_shard_map(
         pp_loss,
-        mesh=mesh,
-        in_specs=(param_specs, batch_spec, batch_spec),
-        out_specs=P(),
+        mesh,
+        (param_specs, batch_spec, batch_spec),
+        P(),
     )
 
     def init_fn(key, init_params_fn) -> TrainState:
