@@ -1238,6 +1238,27 @@ def run_micro() -> dict:
 
     results["kv_block_alloc_per_s"] = _micro_case(_kv_cycle, 2000)
 
+    # 0b. RL rollout queue: put + get cycle rate (ISSUE 13). Pure
+    # host-side bookkeeping on the decoupled dataflow's hand-off hot
+    # path — both staleness gates evaluated per put, occupancy
+    # accounting per op, no cluster (metrics drop outside a session).
+    # One op = offer one wrapped-ref fragment + drain it, the shape
+    # of one fragment's queue lifetime; a regression here taxes every
+    # rollout fragment end to end.
+    from ray_tpu.rl.rollout_queue import RolloutQueue
+
+    rl_queue = RolloutQueue(capacity=64, max_weight_lag=4)
+    _frag = {"ref": ["sentinel"]}
+    _meta = {"weight_version": 0, "env_steps": 512}
+
+    def _queue_cycle():
+        rl_queue.put(_frag, _meta)
+        rl_queue.get_batch(1)
+
+    results["rollout_queue_put_get_per_s"] = _micro_case(
+        _queue_cycle, 2000
+    )
+
     # 8 CPUs: the suite holds up to 6 live actors (1 latency counter,
     # 4 n:n actors, 1 DAG echo) plus task workers.
     rt.init(num_cpus=8)
@@ -1382,6 +1403,41 @@ def run_micro() -> dict:
         results["put_get_64mb_gbps"] = _micro_case(
             _lap, 3, scale=big.nbytes / 1e9, digits=2, warmup=4,
             trials=9,
+        )
+
+        # 8b. drainless weight sync latency, ms (ISSUE 13): one
+        # learner publish end to end — rt.put of the policy params +
+        # concurrent fan-out to the weight store and rollout queue
+        # actors + all acks (the same push_weights the decoupled RL
+        # learner calls per update; engine pushes add one more
+        # parallel ack). Committed as MILLISECONDS (lower is better);
+        # the quiet-band spread logic is direction-agnostic.
+        from ray_tpu.rl.models import init_policy_params
+        from ray_tpu.rl.rollout_queue import (
+            RolloutQueue as _RQueue,
+        )
+        from ray_tpu.rl.weight_sync import WeightStore, push_weights
+
+        import jax as _jax
+
+        _store = rt.remote(num_cpus=0)(WeightStore).remote()
+        _queue = rt.remote(num_cpus=0)(_RQueue).remote(16, 4)
+        rt.get(_store.ping.remote(), timeout=60)
+        rt.get(_queue.ping.remote(), timeout=60)
+        _policy = _jax.device_get(
+            init_policy_params(_jax.random.PRNGKey(0), 4, 2)
+        )
+        _sync_version = [0]
+
+        def _sync_trial() -> float:
+            _sync_version[0] += 1
+            return push_weights(
+                _policy, _sync_version[0],
+                store=_store, queue=_queue,
+            )
+
+        results["weight_sync_ms"] = _micro_case_from(
+            _sync_trial, digits=2, trials=9, warmup=2
         )
 
         # 9. compiled DAG hop (channel round-trip vs RPC)

@@ -224,6 +224,23 @@ class Config:
     #: (closing every edge) and raises rather than hang past it.
     pipeline_step_timeout_s: float = 600.0
 
+    # ---- decoupled RL dataflow (rl/dataflow.py, ISSUE 13) ----
+    #: Rollout-queue capacity in FRAGMENTS: past it, env-runner puts
+    #: are refused ("full") and runners wait — the backpressure that
+    #: throttles actors when the learner falls behind instead of
+    #: growing an unbounded staleness backlog.
+    rl_rollout_queue_capacity: int = 16
+    #: Bound on off-policy staleness in weight VERSIONS: a fragment
+    #: generated more than this many published learner versions ago
+    #: is refused at put ("throttle": the runner refreshes weights
+    #: first) and dropped at get if it aged out while queued. 0 =
+    #: strictly on-policy-by-version.
+    rl_max_weight_lag: int = 4
+    #: Publish learner weights (drainless engine push + weight-store
+    #: publish) every N learner updates. 1 = every update, the
+    #: synchronous path's freshness at none of its blocking.
+    rl_weight_sync_interval_updates: int = 1
+
     # ---- testing / chaos ----
     #: Fault-injection spec "method=count" — drop the first `count`
     #: RPCs with the given method name (reference: rpc_chaos.h:23-31,

@@ -4693,6 +4693,104 @@ class NodeDaemon:
             }
         return out
 
+    def _rl_summary(self) -> dict:
+        """Decoupled-RL dataflow series for the doctor verdict: fold
+        the rl_* metrics (rollout_queue.py / weight_sync.py /
+        dataflow.py) into one view and NAME the bottleneck —
+        `learner` when the queue pins at capacity or sheds stale
+        fragments (runners outpace the learner), `runners` when the
+        learner's polls keep finding the queue empty (actors can't
+        feed it), `balanced` otherwise. The same attribution the
+        step-telemetry goodput shows as queue_wait stall share."""
+        series: dict = {}
+        with self._lock:
+            for name in (
+                "rl_queue_depth",
+                "rl_queue_capacity",
+                "rl_queue_learner_version",
+                "rl_weight_version",
+                "rl_weight_lag",
+                "rl_env_steps",
+            ):
+                entry = self._metrics_table.get(name)
+                if not entry:
+                    continue
+                values = [
+                    bucket.get("value")
+                    for bucket in entry["by_tags"].values()
+                    if bucket.get("value") is not None
+                ]
+                if values:
+                    series[name] = max(values)
+            for name in (
+                "rl_queue_puts_total",
+                "rl_queue_gets_total",
+                "rl_queue_full_total",
+                "rl_queue_throttled_total",
+                "rl_queue_stale_dropped_total",
+                "rl_queue_empty_gets_total",
+                "rl_env_steps_total",
+                "rl_learner_updates_total",
+            ):
+                entry = self._metrics_table.get(name)
+                if not entry:
+                    continue
+                series[name] = sum(
+                    bucket.get("total", 0)
+                    for bucket in entry["by_tags"].values()
+                )
+            entry = self._metrics_table.get("rl_weight_sync_ms")
+            if entry and entry["by_tags"]:
+                bucket = next(iter(entry["by_tags"].values()))
+                hist = self._finish_histogram(
+                    bucket, entry.get("boundaries", ())
+                )
+                series["rl_weight_sync_ms"] = {
+                    k: hist[k]
+                    for k in ("count", "p50", "p99", "max")
+                    if k in hist
+                }
+        if not series:
+            return {}
+        out: dict = {"series": series}
+        puts = series.get("rl_queue_puts_total", 0)
+        full = series.get("rl_queue_full_total", 0)
+        stale = series.get("rl_queue_stale_dropped_total", 0) + (
+            series.get("rl_queue_throttled_total", 0)
+        )
+        empty = series.get("rl_queue_empty_gets_total", 0)
+        gets = series.get("rl_queue_gets_total", 0)
+        depth = series.get("rl_queue_depth", 0)
+        capacity = series.get("rl_queue_capacity", 0)
+        offered = puts + full
+        if offered and (
+            full >= 0.1 * offered
+            or stale >= 0.1 * offered
+            or (capacity and depth >= 0.75 * capacity)
+        ):
+            verdict, detail = "learner", (
+                "queue backpressure engaged (full "
+                f"{full}/{offered} puts, {stale} stale-gated, depth "
+                f"{depth:g}/{capacity:g}) — runners outpace the "
+                "learner; scale the learner or raise max_weight_lag"
+            )
+        elif (gets + empty) and empty >= 0.6 * (gets + empty) and (
+            not capacity or depth <= 0.25 * capacity
+        ):
+            verdict, detail = "runners", (
+                f"learner polls found the queue empty {empty}x vs "
+                f"{gets} fragments served — actors can't feed it; "
+                "add env runners or check policy-inference latency"
+            )
+        else:
+            verdict, detail = "balanced", (
+                "queue occupancy and gates show no sustained "
+                "one-sided pressure"
+            )
+        out["bottleneck"] = verdict
+        out["detail"] = detail
+        return out
+
     def _h_metrics_summary(self, conn, msg):
         if not self.is_head:
             return self.head.call("metrics_summary")
@@ -5095,6 +5193,9 @@ class NodeDaemon:
         # whose consumer sits longest in recv names its PRODUCER as
         # the slow side.
         dag = self._dag_edge_summary()
+        # Decoupled-RL dataflow: queue levels/gates + weight versions
+        # folded into an actor-vs-learner bottleneck attribution.
+        rl = self._rl_summary()
         workers = steps.get("workers", {})
         if len(workers) >= 2:
             medians = sorted(
@@ -5334,6 +5435,7 @@ class NodeDaemon:
                 "problems": problems,
                 "steps": steps,
                 "dag": dag,
+                "rl": rl,
                 "rpc": ring_digests,
                 "nodes": {
                     "total": summary["nodes"],

@@ -143,10 +143,16 @@ def timed_iter(
 #: before/around the step, then the step itself. send/recv wait are
 #: the MPMD pipeline's channel-blocked time (dag/edges.py bills them)
 #: — the per-stage bubble attribution the pipeline doctor reads.
+#: queue_wait is the decoupled RL dataflow's rollout-queue stall
+#: (rl/dataflow.py bills it) — the learner starving on rollouts,
+#: billed exactly like a trainer starving on input (data_wait);
+#: weight_sync is its drainless weight-publish stall.
 _TRACE_PHASES = (
     "data_wait_ms",
+    "queue_wait_ms",
     "h2d_ms",
     "ckpt_block_ms",
+    "weight_sync_ms",
     "send_wait_ms",
     "recv_wait_ms",
     "step_ms",
@@ -225,10 +231,16 @@ def steps_to_chrome_trace(records) -> list:
 #: Wait phases that classify as stall time in goodput accounting.
 #: send/recv wait are pipeline-channel blocked time: for an MPMD
 #: stage, that IS the (bubble + transport) share of its wall.
+#: queue_wait/weight_sync are the RL dataflow's consume-side stalls —
+#: a learner whose goodput is eaten by queue_wait is runner-bound,
+#: one eaten by weight_sync is sync-bound (doctor's verdict.rl reads
+#: the same attribution from the rl_* series).
 _STALL_PHASES = (
     "data_wait_ms",
+    "queue_wait_ms",
     "h2d_ms",
     "ckpt_block_ms",
+    "weight_sync_ms",
     "send_wait_ms",
     "recv_wait_ms",
 )

@@ -9,10 +9,12 @@ traffic, single- and multi-replica (results in SERVEBENCH.json).
 """
 
 from .engine import (
+    BatchProgram,
     EngineConfig,
     EngineDead,
     EngineOverloaded,
     InferenceEngine,
+    PolicyTicket,
     TokenStream,
 )
 from .scheduler import SlotScheduler
@@ -20,10 +22,12 @@ from .kv_slots import BlockAllocator, BlocksExhausted, PagedKVCache
 from .serving import LLMServer, build_llm_app
 
 __all__ = [
+    "BatchProgram",
     "EngineConfig",
     "EngineDead",
     "EngineOverloaded",
     "InferenceEngine",
+    "PolicyTicket",
     "TokenStream",
     "SlotScheduler",
     "BlockAllocator",

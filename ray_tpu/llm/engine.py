@@ -37,6 +37,32 @@ runs outside it. One engine = one step thread = one model family.
 Failure: if the step loop dies, every in-flight and queued request is
 failed with the loop's exception (consumers raise, never hang) and
 subsequent submits raise EngineDead.
+
+ISSUE 13 additions — the engine as the inference half of a decoupled
+RL dataflow:
+
+* **Drainless versioned weight sync** (`update_weights`): a weight
+  push installs a new parameter GENERATION without stopping the step
+  loop. Every request pins the generation that was latest at its
+  ADMISSION and decodes on it to completion — a push mid-decode
+  leaves in-flight streams token-exact on the old weights — while the
+  next admission (and every policy batch) uses the new generation.
+  During the transient mixed window the decode batch partitions by
+  generation and runs one masked decode step per generation (disjoint
+  alive masks over the same pool; `last_logits` rows merge back), so
+  nothing is drained, shed or errored on account of the push. Old
+  generations are dropped the moment their last pinned request
+  retires.
+* **Pluggable batch program** (`program=`, `submit_policy`): ragged
+  per-env action requests are the same problem as ragged chat traffic,
+  so the same step loop serves them — callers submit small row
+  batches of observations from any thread, the loop coalesces
+  everything pending into one padded bucket and runs the program's
+  jitted forward ONCE (batched logits/action outputs), then scatters
+  the rows back to their tickets. A policy-only engine passes
+  ``cfg=None`` and skips the KV cache/slot machinery entirely; an LLM
+  engine may serve both paths (the RLHF shape: rollout generation and
+  scoring on one engine).
 """
 
 from __future__ import annotations
@@ -46,6 +72,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -57,6 +84,8 @@ __all__ = [
     "EngineConfig",
     "InferenceEngine",
     "TokenStream",
+    "PolicyTicket",
+    "BatchProgram",
     "EngineOverloaded",
     "EngineDead",
 ]
@@ -108,6 +137,9 @@ class EngineConfig:
     seed: int = 0
     #: Idle-loop park time waiting for work.
     idle_wait_s: float = 0.02
+    #: Bound on pending policy-path rows (submit_policy sheds with
+    #: EngineOverloaded past it); only meaningful with a `program`.
+    max_policy_rows: int = 4096
 
 
 class _Request:
@@ -116,7 +148,7 @@ class _Request:
         "out", "cancelled", "submitted_ts", "first_token_ts",
         "emitted", "slot", "bucket", "offset", "padded",
         "prefix_keys", "total_blocks", "block_ids", "n_shared",
-        "skip",
+        "skip", "gen",
     )
 
     def __init__(
@@ -150,6 +182,10 @@ class _Request:
         self.block_ids: List[int] = []
         self.n_shared = 0
         self.skip = 0
+        #: Weight generation pinned at ADMISSION (None until then):
+        #: the request prefils and decodes on this generation to
+        #: completion even if update_weights lands mid-stream.
+        self.gen: Optional[int] = None
 
 
 class TokenStream:
@@ -200,6 +236,99 @@ class TokenStream:
         self._engine.cancel(self._req.request_id)
 
 
+class BatchProgram:
+    """Pluggable batch-program hook for the engine's policy path.
+
+    A program turns one PADDED row batch of inputs into a dict of
+    per-row output arrays with ONE (jitted) call; the engine's step
+    loop owns batching — it coalesces every pending `submit_policy`
+    request into the smallest bucket that fits and scatters the
+    output rows back to their tickets. Subclasses (e.g.
+    rl.dataflow.PolicyProgram) override `run`; `buckets` is the
+    ascending set of padded batch sizes (the compile-once shape set,
+    exactly like the prefill length buckets on the LLM path).
+    """
+
+    #: Ascending padded batch sizes; a single submit may not exceed
+    #: buckets[-1] rows.
+    buckets: tuple = (8, 16, 32, 64, 128, 256)
+
+    def bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    def run(self, params, inputs, key) -> Dict[str, Any]:
+        """(params, padded inputs [bucket, ...], PRNG key) -> dict of
+        [bucket, ...] output arrays. Must be shape-stable per bucket
+        (jit compiles once per bucket)."""
+        raise NotImplementedError
+
+
+class _PolicyRequest:
+    __slots__ = (
+        "inputs", "n", "done", "result", "error", "version",
+        "submitted_ts",
+    )
+
+    def __init__(self, inputs: np.ndarray):
+        self.inputs = inputs
+        self.n = int(len(inputs))
+        self.done = threading.Event()
+        self.result: Optional[Dict[str, np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.version: Optional[int] = None
+        self.submitted_ts = time.perf_counter()
+
+
+class PolicyTicket:
+    """Consumer side of one policy-path request: `result()` blocks
+    until the engine's step loop has served the rows (raising, never
+    hanging, if the engine dies first). `version` is the weight
+    version the reply was computed with — the staleness signal the
+    RL dataflow's `max_weight_lag` throttle reads."""
+
+    def __init__(self, engine: "InferenceEngine", req: _PolicyRequest):
+        self._engine = engine
+        self._req = req
+
+    @property
+    def version(self) -> Optional[int]:
+        return self._req.version
+
+    def result(
+        self, timeout: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        deadline = (
+            None if timeout is None else time.perf_counter() + timeout
+        )
+        while True:
+            wait = 1.0
+            if deadline is not None:
+                wait = min(wait, deadline - time.perf_counter())
+                if wait <= 0:
+                    raise TimeoutError(
+                        "policy request not served in time"
+                    )
+            if self._req.done.wait(wait):
+                break
+            # Belt-and-braces (same contract as TokenStream): a dead
+            # engine fails every ticket, but if this one somehow
+            # missed the sentinel the consumer must raise, not hang.
+            if (
+                self._engine._dead is not None
+                and not self._req.done.is_set()
+            ):
+                raise EngineDead(
+                    "engine died with policy request pending"
+                ) from self._engine._dead
+        if self._req.error is not None:
+            raise self._req.error
+        assert self._req.result is not None
+        return self._req.result
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -210,6 +339,7 @@ class InferenceEngine:
         family: str = "",
         app: str = "",
         deployment: str = "",
+        program: Optional[BatchProgram] = None,
     ):
         import jax
 
@@ -218,38 +348,63 @@ class InferenceEngine:
         self.cfg = cfg
         self.config = ec
         self.family = family
+        self._program = program
+        if cfg is None and program is None:
+            raise ValueError(
+                "cfg=None (policy-only engine) requires a `program`"
+            )
         self._tags = {
             "app": app, "deployment": deployment,
             "family": family or "default",
         }
-        block_len = ec.kv_block_len or default_block_len(
-            ec.prefill_chunk
-        )
-        n_blocks = ec.kv_blocks or (
-            ec.slots * (ec.max_len // block_len) + 1
-        )
-        self._kv = PagedKVCache(
-            cfg, n_blocks, block_len, ec.max_len, ec.prefill_chunk
-        )
-        self._sched = SlotScheduler(ec.slots, ec.max_waiting)
         self._lock = threading.Lock()
         self._wake = threading.Event()
+        # Versioned weight generations (drainless sync): generation
+        # index -> {version, params, refs}. `refs` counts the LLM
+        # requests pinned at admission; a non-latest generation is
+        # dropped the moment its count returns to zero. The policy
+        # path always reads the latest generation and pins nothing
+        # (one batch = one forward, no stream to keep token-exact).
+        self._gens: Dict[int, Dict[str, Any]] = {
+            0: {"version": 0, "params": params, "refs": 0}
+        }
+        self._gen_latest = 0
+        self._weight_version = 0
+        if cfg is not None:
+            block_len = ec.kv_block_len or default_block_len(
+                ec.prefill_chunk
+            )
+            n_blocks = ec.kv_blocks or (
+                ec.slots * (ec.max_len // block_len) + 1
+            )
+            self._kv = PagedKVCache(
+                cfg, n_blocks, block_len, ec.max_len, ec.prefill_chunk
+            )
+            self._sched = SlotScheduler(ec.slots, ec.max_waiting)
+        else:
+            self._kv = None
+            self._sched = None
         # Per-slot decode state. positions/alive/tables live host-side
         # (the engine mutates them per admission/step); last_logits
         # stays on device.
         import jax.numpy as jnp
 
-        self._positions = np.zeros(ec.slots, np.int32)
-        self._alive = np.zeros(ec.slots, bool)
-        self._tables = np.full(
-            (ec.slots, self._kv.max_blocks), NULL_BLOCK, np.int32
-        )
-        self._last_logits = jnp.zeros(
-            (ec.slots, cfg.vocab_size), jnp.float32
-        )
+        if cfg is not None:
+            self._positions = np.zeros(ec.slots, np.int32)
+            self._alive = np.zeros(ec.slots, bool)
+            self._tables = np.full(
+                (ec.slots, self._kv.max_blocks), NULL_BLOCK, np.int32
+            )
+            self._last_logits = jnp.zeros(
+                (ec.slots, cfg.vocab_size), jnp.float32
+            )
         self._base_key = jax.random.PRNGKey(ec.seed)
         self._prefilling: Optional[_Request] = None
         self._by_id: Dict[str, _Request] = {}
+        self._policy_pending: "deque[_PolicyRequest]" = deque()
+        self._policy_rows_pending = 0
+        self._policy_steps = 0
+        self._policy_rows_served = 0
         self._steps = 0
         self._tokens_emitted = 0
         self._requests_done = 0
@@ -275,6 +430,11 @@ class InferenceEngine:
         request_id: Optional[str] = None,
     ) -> TokenStream:
         ec = self.config
+        if self._kv is None:
+            raise ValueError(
+                "policy-only engine (cfg=None) has no LLM path; use "
+                "submit_policy()"
+            )
         max_new = int(
             ec.max_new_tokens if max_new_tokens is None
             else max_new_tokens
@@ -341,23 +501,118 @@ class InferenceEngine:
         self._wake.set()
         return True
 
+    def update_weights(
+        self, params: Dict[str, Any], *, version: Optional[int] = None
+    ) -> int:
+        """Install a new weight generation WITHOUT draining the
+        engine (ISSUE 13 tentpole): in-flight LLM requests keep the
+        generation they were admitted under and finish token-exact on
+        it; the next admission — and the next policy batch — serves
+        the new weights. Returns the installed weight version
+        (monotonic; pass `version` to carry the learner's own
+        numbering onto /metrics)."""
+        if version is not None and version != int(version):
+            raise ValueError(
+                f"version must be integral, got {version!r}"
+            )
+        with self._lock:
+            if self._dead is not None or self._stopping:
+                raise EngineDead(
+                    "engine is shut down"
+                ) from self._dead
+            v = (
+                int(version) if version is not None
+                else self._weight_version + 1
+            )
+            if v <= self._weight_version:
+                raise ValueError(
+                    f"weight version must increase: got {v}, "
+                    f"serving {self._weight_version}"
+                )
+            self._gen_latest += 1
+            self._gens[self._gen_latest] = {
+                "version": v, "params": params, "refs": 0,
+            }
+            self._weight_version = v
+            self.params = params
+            self._prune_gens_locked()
+        self._observe_weights()
+        self._wake.set()
+        return v
+
+    def _prune_gens_locked(self) -> None:
+        for gen in [
+            g for g, e in self._gens.items()
+            if g != self._gen_latest and e["refs"] <= 0
+        ]:
+            del self._gens[gen]
+
+    def submit_policy(self, inputs) -> PolicyTicket:
+        """Queue one row batch for the policy batch program; the step
+        loop coalesces everything pending into one padded bucket and
+        runs the program's jitted forward once. Ragged per-env
+        requests from many callers batch exactly like ragged chat
+        traffic on the LLM path."""
+        if self._program is None:
+            raise ValueError(
+                "engine was built without a policy batch program"
+            )
+        inputs = np.asarray(inputs)
+        if inputs.ndim < 1 or len(inputs) < 1:
+            raise ValueError("submit_policy needs >= 1 input row")
+        if len(inputs) > self._program.buckets[-1]:
+            raise ValueError(
+                f"policy batch of {len(inputs)} rows exceeds the "
+                f"program's largest bucket "
+                f"{self._program.buckets[-1]}; split it"
+            )
+        req = _PolicyRequest(inputs)
+        with self._lock:
+            if self._dead is not None or self._stopping:
+                raise EngineDead(
+                    "engine is shut down"
+                ) from self._dead
+            if (
+                self._policy_rows_pending + req.n
+                > self.config.max_policy_rows
+            ):
+                raise EngineOverloaded(
+                    f"policy backlog full "
+                    f"({self.config.max_policy_rows} rows); shed"
+                )
+            self._policy_pending.append(req)
+            self._policy_rows_pending += req.n
+        self._wake.set()
+        return PolicyTicket(self, req)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            out = self._sched.stats()
+            out = (
+                self._sched.stats() if self._sched is not None
+                else {"slots_total": 0, "slots_used": 0, "waiting": 0}
+            )
             out.update(
                 family=self.family,
                 steps=self._steps,
                 tokens_emitted=self._tokens_emitted,
                 requests_done=self._requests_done,
                 prefilling=self._prefilling is not None,
-                kv_bytes=self._kv.nbytes(),
-                kv_block_len=self._kv.block_len,
                 prefix_hits=self._prefix_hits,
                 prefix_misses=self._prefix_misses,
                 prefix_tokens_saved=self._prefix_tokens_saved,
+                weight_version=self._weight_version,
+                weight_gens=len(self._gens),
+                policy_pending_rows=self._policy_rows_pending,
+                policy_steps=self._policy_steps,
+                policy_rows_served=self._policy_rows_served,
                 dead=self._dead is not None,
-                **self._kv.alloc.stats(),
             )
+            if self._kv is not None:
+                out.update(
+                    kv_bytes=self._kv.nbytes(),
+                    kv_block_len=self._kv.block_len,
+                    **self._kv.alloc.stats(),
+                )
         return out
 
     def close(self) -> None:
@@ -398,14 +653,89 @@ class InferenceEngine:
                 self._fail_all_locked(failure)
 
     def _step(self) -> bool:
-        """One engine iteration; returns whether any work happened."""
+        """One engine iteration; returns whether any work happened.
+        Policy batches go first: their callers are blocked env-runner
+        threads, and one batched forward is cheap next to a decode
+        step over the full slot batch."""
         worked = self._reap_cancelled()
-        worked = self._advance_prefill() or worked
-        worked = self._decode() or worked
+        worked = self._policy_step() or worked
+        if self._sched is not None:
+            worked = self._advance_prefill() or worked
+            worked = self._decode() or worked
         return worked
+
+    # -- policy path ---------------------------------------------------
+    def _policy_step(self) -> bool:
+        """Serve every pending policy request that fits the largest
+        bucket in ONE padded batched forward on the LATEST weight
+        generation; scatter output rows back to their tickets."""
+        if self._program is None:
+            return False
+        with self._lock:
+            if not self._policy_pending:
+                return False
+            cap = self._program.buckets[-1]
+            batch: List[_PolicyRequest] = []
+            rows = 0
+            while (
+                self._policy_pending
+                and rows + self._policy_pending[0].n <= cap
+            ):
+                req = self._policy_pending.popleft()
+                self._policy_rows_pending -= req.n
+                batch.append(req)
+                rows += req.n
+            entry = self._gens[self._gen_latest]
+            params, version = entry["params"], entry["version"]
+        import jax
+
+        t0 = time.perf_counter()
+        bucket = self._program.bucket_for(rows)
+        sample = batch[0].inputs
+        padded = np.zeros(
+            (bucket, *sample.shape[1:]), dtype=sample.dtype
+        )
+        cursor = 0
+        for req in batch:
+            padded[cursor:cursor + req.n] = req.inputs
+            cursor += req.n
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, 0x9E37),
+            self._policy_steps,
+        )
+        try:
+            outs = self._program.run(params, padded, key)
+            host = {k: np.asarray(v) for k, v in outs.items()}
+        except BaseException as e:
+            # A program failure fails THIS batch's tickets (the
+            # callers must not hang) and then the loop: a broken
+            # program cannot serve the next batch either.
+            for req in batch:
+                req.error = EngineDead(
+                    f"policy batch program failed: {e!r}"
+                )
+                req.error.__cause__ = e
+                req.done.set()
+            raise
+        cursor = 0
+        for req in batch:
+            req.result = {
+                k: v[cursor:cursor + req.n] for k, v in host.items()
+            }
+            req.version = version
+            req.done.set()
+            cursor += req.n
+        self._policy_steps += 1
+        self._policy_rows_served += rows
+        self._observe_policy(
+            (time.perf_counter() - t0) * 1e3, rows, bucket
+        )
+        return True
 
     # -- cancellation / completion ------------------------------------
     def _reap_cancelled(self) -> bool:
+        if self._sched is None:
+            return False
         worked = False
         with self._lock:
             # The prefilling request is ALSO in sched.running (its
@@ -440,7 +770,17 @@ class InferenceEngine:
             # free (the allocator would raise and kill the loop).
             self._kv.alloc.release(req.block_ids)
             req.block_ids = []
+        self._unpin_gen_locked(req)
         self._finish_locked(req, reason)
+
+    def _unpin_gen_locked(self, req: _Request) -> None:
+        if req.gen is None:
+            return
+        entry = self._gens.get(req.gen)
+        req.gen = None
+        if entry is not None:
+            entry["refs"] -= 1
+            self._prune_gens_locked()
 
     def _finish_locked(self, req: _Request, reason: str) -> None:
         self._by_id.pop(req.request_id, None)
@@ -459,9 +799,10 @@ class InferenceEngine:
             self._prefilling = None
         else:
             doomed = []
-        doomed.extend(self._sched.drain())
-        self._alive[:] = False
-        self._tables[:, :] = NULL_BLOCK
+        if self._sched is not None:
+            doomed.extend(self._sched.drain())
+            self._alive[:] = False
+            self._tables[:, :] = NULL_BLOCK
         for req in doomed:
             if req.block_ids:
                 try:
@@ -469,8 +810,17 @@ class InferenceEngine:
                 except Exception:
                     pass  # dying anyway; never mask the real failure
                 req.block_ids = []
+            req.gen = None
             self._by_id.pop(req.request_id, None)
             req.out.put(("err", error))
+        # Pending policy tickets fail FAST too: their callers are
+        # synchronously blocked env-runner threads — an engine death
+        # must turn into EngineDead there, never a hang.
+        while self._policy_pending:
+            preq = self._policy_pending.popleft()
+            self._policy_rows_pending -= preq.n
+            preq.error = error
+            preq.done.set()
         self._observe_occupancy()
 
     # -- admission / block allocation ---------------------------------
@@ -549,6 +899,13 @@ class InferenceEngine:
                     return False
                 req, slot = admitted
                 req.slot = slot
+                # Pin the weight generation at ADMISSION: everything
+                # this request computes — every prefill chunk and
+                # every decode step — uses these params, even if a
+                # weight push lands mid-stream (drainless sync's
+                # token-exactness contract).
+                req.gen = self._gen_latest
+                self._gens[req.gen]["refs"] += 1
                 self._allocate_locked(req)
                 self._prefilling = req
         if req.padded is None:
@@ -562,7 +919,7 @@ class InferenceEngine:
         tokens = jnp.asarray(req.padded[:, req.offset:req.offset + chunk])
         table = jnp.asarray(self._tables[req.slot:req.slot + 1])
         logits, pool = paged_prefill(
-            self.params,
+            self._gens[req.gen]["params"],
             self.cfg,
             tokens,
             self._kv.pool,
@@ -628,21 +985,78 @@ class InferenceEngine:
         ec = self.config
         t0 = time.perf_counter()
         key = jax.random.fold_in(self._base_key, self._steps)
-        token, pool, last_logits = paged_decode_step(
-            self.params,
-            self.cfg,
-            self._kv.pool,
-            jnp.asarray(self._tables),
-            self._last_logits,
-            jnp.asarray(self._positions),
-            jnp.asarray(self._alive),
-            key,
-            temperature=ec.temperature,
-            top_k=ec.top_k,
-        )
-        self._kv.pool = pool
-        self._last_logits = last_logits
-        tokens = np.asarray(token)  # device->host sync per step
+        # Partition the alive batch by pinned weight generation. In
+        # steady state there is exactly one group and this is the
+        # PR 11 fast path verbatim; in the transient window after an
+        # update_weights there are two (old streams finishing, new
+        # admissions starting) and each runs its own masked decode
+        # step over the SAME pool — masks are disjoint and dead rows
+        # scatter to the null block, so the groups can't cross-talk.
+        with self._lock:
+            by_gen: Dict[int, List[int]] = {}
+            for slot in alive_idx:
+                req = self._sched.running.get(int(slot))
+                if req is None:
+                    continue
+                by_gen.setdefault(
+                    req.gen if req.gen is not None else 0, []
+                ).append(int(slot))
+        if not by_gen:
+            return False
+        tables = jnp.asarray(self._tables)
+        positions = jnp.asarray(self._positions)
+        if len(by_gen) == 1:
+            gen = next(iter(by_gen))
+            token, pool, last_logits = paged_decode_step(
+                self._gens[gen]["params"],
+                self.cfg,
+                self._kv.pool,
+                tables,
+                self._last_logits,
+                positions,
+                jnp.asarray(self._alive),
+                key,
+                temperature=ec.temperature,
+                top_k=ec.top_k,
+            )
+            self._kv.pool = pool
+            self._last_logits = last_logits
+            tokens = np.asarray(token)  # device->host sync per step
+        else:
+            # Mixed-generation window: paged_decode_step donates
+            # last_logits on accelerator backends, so each group gets
+            # a PRIVATE copy of the pre-step logits (`+ 0` forces a
+            # fresh buffer) and the surviving rows merge back — a
+            # group must never read another group's freshly-written
+            # junk rows, and the donated original must never be
+            # reused.
+            base_logits = self._last_logits
+            merged = base_logits
+            pool = self._kv.pool
+            tokens = np.zeros(ec.slots, np.int64)
+            for gen in sorted(by_gen):
+                mask = np.zeros(ec.slots, bool)
+                mask[by_gen[gen]] = True
+                gmask = jnp.asarray(mask)
+                token, pool, out_logits = paged_decode_step(
+                    self._gens[gen]["params"],
+                    self.cfg,
+                    pool,
+                    tables,
+                    base_logits + 0,
+                    positions,
+                    gmask,
+                    key,
+                    temperature=ec.temperature,
+                    top_k=ec.top_k,
+                )
+                merged = jnp.where(
+                    gmask[:, None], out_logits, merged
+                )
+                group_tokens = np.asarray(token)
+                tokens[mask] = group_tokens[mask]
+            self._kv.pool = pool
+            self._last_logits = merged
         step_ms = (time.perf_counter() - t0) * 1e3
         self._steps += 1
         now = time.perf_counter()
@@ -676,6 +1090,8 @@ class InferenceEngine:
     # definitions; the engine just reports).
 
     def _block_stats(self) -> Dict[str, int]:
+        if self._kv is None:
+            return {"kv_used": 0, "kv_total": 0, "kv_cached": 0}
         alloc = self._kv.alloc
         return {
             "kv_used": alloc.used(),
@@ -736,11 +1152,31 @@ class InferenceEngine:
                 observe_engine_occupancy,
             )
 
+            if self._sched is None:
+                return
             stats = self._sched.stats()
             observe_engine_occupancy(
                 self._tags, stats["slots_used"],
                 stats["slots_total"], stats["waiting"],
                 **self._block_stats(),
             )
+        except Exception:
+            pass
+
+    def _observe_weights(self) -> None:
+        try:
+            from ..serve.observability import observe_engine_weights
+
+            observe_engine_weights(self._tags, self._weight_version)
+        except Exception:
+            pass
+
+    def _observe_policy(
+        self, batch_ms: float, rows: int, bucket: int
+    ) -> None:
+        try:
+            from ..serve.observability import observe_engine_policy
+
+            observe_engine_policy(self._tags, batch_ms, rows, bucket)
         except Exception:
             pass

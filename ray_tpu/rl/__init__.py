@@ -1,13 +1,26 @@
 """Reinforcement learning (reference: rllib/ new API stack —
-EnvRunnerGroup + Learner + Algorithm)."""
+EnvRunnerGroup + Learner + Algorithm), plus the ISSUE 13 decoupled
+Sebulba-style dataflow (dataflow.py / rollout_queue.py /
+weight_sync.py): `PPOConfig().dataflow()` / `DQNConfig().dataflow()`
+switch either algorithm from the synchronous sample -> update ->
+broadcast loop onto pipelined rollout/learner stages with
+engine-served policy inference and drainless weight sync."""
 
 from .actor_manager import CallResult, FaultTolerantActorManager
-from .dqn import DQN, DQNConfig, ReplayBuffer
+from .dataflow import (
+    DataflowConfig,
+    PolicyEngineActor,
+    PolicyProgram,
+    RLDataflow,
+)
+from .dqn import DQN, DecoupledDQN, DQNConfig, DQNLearner, ReplayBuffer
 from .env import ENV_REGISTRY, CartPoleEnv, VectorEnv, make_env
 from .env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from .learner import JaxLearner
 from .learner_group import LearnerGroup
-from .ppo import PPO, PPOConfig
+from .ppo import PPO, DecoupledPPO, PPOConfig
+from .rollout_queue import RolloutQueue
+from .weight_sync import WeightStore, push_weights
 
 __all__ = [
     "CartPoleEnv",
@@ -22,7 +35,17 @@ __all__ = [
     "LearnerGroup",
     "PPO",
     "PPOConfig",
+    "DecoupledPPO",
     "DQN",
     "DQNConfig",
+    "DQNLearner",
+    "DecoupledDQN",
     "ReplayBuffer",
+    "RLDataflow",
+    "DataflowConfig",
+    "PolicyProgram",
+    "PolicyEngineActor",
+    "RolloutQueue",
+    "WeightStore",
+    "push_weights",
 ]

@@ -98,25 +98,59 @@ class FaultTolerantActorManager:
                 )
                 if mark_unhealthy_on_failure:
                     self._healthy[idx] = False
-        # ONE deadline across the whole fan-out: sequential per-ref
-        # timeouts would compound (3 hung actors = 3x the budget); the
-        # reference manager bounds the pass at `timeout` total.
+        # CONCURRENT gather (ISSUE 13 satellite): one rt.wait over
+        # the whole fan-out instead of serial per-ref round-trips —
+        # N healthy actors complete in one pass and a single dead
+        # actor costs the remaining budget ONCE, not once per
+        # still-pending ref behind it. ONE deadline bounds the pass
+        # (sequential timeouts would compound: 3 hung actors = 3x
+        # the budget), matching the reference manager's contract.
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        for idx, ref in refs.items():
-            remaining = max(0.05, deadline - _time.monotonic())
-            try:
-                value = rt.get(ref, timeout=remaining)
-                results.append(
-                    CallResult(actor_id=idx, ok=True, value=value)
+        pending = dict(refs)
+        while pending:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            ready, _ = rt.wait(
+                list(pending.values()),
+                num_returns=len(pending),
+                timeout=remaining,
+            )
+            ready_set = set(ready)
+            drained = [
+                idx for idx, ref in pending.items()
+                if ref in ready_set
+            ]
+            if not drained:
+                break  # deadline hit with nothing new
+            for idx in drained:
+                ref = pending.pop(idx)
+                try:
+                    value = rt.get(ref, timeout=5)
+                    results.append(
+                        CallResult(actor_id=idx, ok=True, value=value)
+                    )
+                except Exception as e:
+                    results.append(
+                        CallResult(actor_id=idx, ok=False, error=e)
+                    )
+                    if mark_unhealthy_on_failure:
+                        self._healthy[idx] = False
+        for idx, ref in pending.items():  # never completed: timeout
+            results.append(
+                CallResult(
+                    actor_id=idx,
+                    ok=False,
+                    error=TimeoutError(
+                        f"{method} on actor {idx} exceeded the "
+                        f"{timeout}s fan-out deadline"
+                    ),
                 )
-            except Exception as e:
-                results.append(
-                    CallResult(actor_id=idx, ok=False, error=e)
-                )
-                if mark_unhealthy_on_failure:
-                    self._healthy[idx] = False
+            )
+            if mark_unhealthy_on_failure:
+                self._healthy[idx] = False
         results.sort(key=lambda r: r.actor_id)
         return results
 

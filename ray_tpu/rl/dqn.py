@@ -63,6 +63,162 @@ class ReplayBuffer:
         }
 
 
+class DQNLearner:
+    """The gradient half of DQN — replay buffer + jitted double-DQN
+    TD update + target sync — extracted (ISSUE 13) so the synchronous
+    `DQN` loop and the decoupled dataflow train through ONE
+    implementation. Satisfies the RLDataflow learner contract:
+    `update(batch)` ingests a transition batch and takes the
+    configured TD steps; `get_weights()/set_weights()` move the
+    online net."""
+
+    #: RLDataflow contract: batches land in the HOST-side replay
+    #: ring (minibatches upload separately in _update_jit), so the
+    #: driver's device-prefetch stage must pass them through as-is.
+    host_ingest = True
+
+    def __init__(
+        self,
+        obs_size: int,
+        num_actions: int,
+        *,
+        lr: float = 5e-4,
+        gamma: float = 0.99,
+        hidden: Tuple[int, ...] = (64, 64),
+        double_q: bool = True,
+        buffer_capacity: int = 50_000,
+        train_batch_size: int = 64,
+        updates_per_batch: int = 128,
+        target_update_freq: int = 100,
+        learning_starts: int = 1_000,
+        seed: int = 0,
+    ):
+        import jax
+        import optax
+
+        from .models import init_policy_params
+
+        self.gamma = gamma
+        self.double_q = double_q
+        self.train_batch_size = train_batch_size
+        self.updates_per_batch = updates_per_batch
+        self.target_update_freq = target_update_freq
+        self.learning_starts = learning_starts
+        # The pi head doubles as the Q head (A outputs); vf unused.
+        self.params = init_policy_params(
+            jax.random.PRNGKey(seed), obs_size, num_actions, hidden
+        )
+        self.target_params = jax.device_get(self.params)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(
+            buffer_capacity, obs_size, seed=seed
+        )
+        self.updates = 0
+        self._update_jit = jax.jit(self._td_update)
+        self._q_jit = jax.jit(self._q_values)
+
+    # -- Q function ----------------------------------------------------
+    @staticmethod
+    def _q_values(params, obs):
+        from .models import apply_policy
+
+        q, _ = apply_policy(params, obs)
+        return q
+
+    def _td_update(self, params, target_params, opt_state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma = self.gamma
+
+        def loss_fn(p):
+            q = self._q_values(p, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1
+            )[:, 0]
+            q_next_target = self._q_values(
+                target_params, batch["next_obs"]
+            )
+            if self.double_q:
+                # Double-DQN: online net picks, target net evaluates
+                # (reference: dqn_rainbow_learner.py double_q branch).
+                q_next_online = self._q_values(p, batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=1)
+            else:
+                best = jnp.argmax(q_next_target, axis=1)
+            next_value = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=1
+            )[:, 0]
+            td_target = batch["rewards"] + gamma * next_value * (
+                1.0 - batch["dones"]
+            )
+            td_target = jax.lax.stop_gradient(td_target)
+            return jnp.mean(
+                optax.huber_loss(q_taken, td_target)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # -- training ------------------------------------------------------
+    def ingest(self, batch: Dict[str, np.ndarray]) -> int:
+        """Append one transition batch (obs/actions/rewards/next_obs/
+        dones arrays) to the replay ring."""
+        self.buffer.add_batch(
+            np.asarray(batch["obs"]),
+            np.asarray(batch["actions"]),
+            np.asarray(batch["rewards"]),
+            np.asarray(batch["next_obs"]),
+            np.asarray(batch["dones"]),
+        )
+        return len(self.buffer)
+
+    def td_steps(self, n: int) -> float:
+        """`n` sampled TD minibatch updates + scheduled target syncs;
+        returns the last loss (nan while below learning_starts)."""
+        import jax
+
+        loss = float("nan")
+        if len(self.buffer) < self.learning_starts:
+            return loss
+        for _ in range(n):
+            batch = self.buffer.sample(self.train_batch_size)
+            device_batch = {
+                k: np.asarray(v) for k, v in batch.items()
+            }
+            self.params, self.opt_state, loss = self._update_jit(
+                self.params,
+                self.target_params,
+                self.opt_state,
+                device_batch,
+            )
+            self.updates += 1
+            if self.updates % self.target_update_freq == 0:
+                self.target_params = jax.device_get(self.params)
+        return float(loss)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """RLDataflow contract: one queue batch in, the configured TD
+        steps out."""
+        self.ingest(batch)
+        loss = self.td_steps(self.updates_per_batch)
+        return {"td_loss": loss, "num_updates": float(self.updates)}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        import jax
+
+        self.params = jax.device_put(params)
+
+
 class DQNConfig:
     """Fluent builder (reference: DQNConfig(AlgorithmConfig))."""
 
@@ -86,6 +242,14 @@ class DQNConfig:
         self.hidden = (64, 64)
         self.seed = 0
         self.double_q = True
+        # Decoupled dataflow (ISSUE 13): off = the synchronous
+        # act -> buffer -> update loop below.
+        self.dataflow_enabled = False
+        self.dataflow_policy = "local"
+        self.num_env_runners = 2
+        self.queue_capacity: Optional[int] = None
+        self.max_weight_lag: Optional[int] = None
+        self.sync_interval_updates: Optional[int] = None
 
     def environment(self, env) -> "DQNConfig":
         self.env_spec = env
@@ -128,7 +292,38 @@ class DQNConfig:
             self.seed = seed
         return self
 
-    def build(self) -> "DQN":
+    def dataflow(
+        self,
+        enabled: bool = True,
+        *,
+        policy: Optional[str] = None,
+        num_env_runners: Optional[int] = None,
+        queue_capacity: Optional[int] = None,
+        max_weight_lag: Optional[int] = None,
+        sync_interval_updates: Optional[int] = None,
+    ) -> "DQNConfig":
+        """Switch `build()` to the decoupled dataflow: runner actors
+        stream transition fragments through the rollout queue into
+        this learner's replay buffer while TD updates run — DQN is
+        replay-based, so staleness tolerance is native and
+        `max_weight_lag` simply bounds how old the BEHAVIOR policy
+        may be. Same knob semantics as PPOConfig.dataflow()."""
+        self.dataflow_enabled = bool(enabled)
+        if policy is not None:
+            self.dataflow_policy = policy
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if queue_capacity is not None:
+            self.queue_capacity = queue_capacity
+        if max_weight_lag is not None:
+            self.max_weight_lag = max_weight_lag
+        if sync_interval_updates is not None:
+            self.sync_interval_updates = sync_interval_updates
+        return self
+
+    def build(self):
+        if self.dataflow_enabled:
+            return DecoupledDQN(self)
         return DQN(self)
 
 
@@ -136,25 +331,25 @@ class DQN:
     """(reference: dqn.py DQN(Algorithm) — train()/save/restore)."""
 
     def __init__(self, config: DQNConfig):
-        import jax
-        import optax
-
-        from .models import init_policy_params
-
         self.config = config
         probe = make_env(config.env_spec, seed=0)
         self.obs_size = probe.observation_size
         self.num_actions = probe.num_actions
-        key = jax.random.PRNGKey(config.seed)
-        # The pi head doubles as the Q head (A outputs); vf unused.
-        self.params = init_policy_params(
-            key, self.obs_size, self.num_actions, config.hidden
-        )
-        self.target_params = jax.device_get(self.params)
-        self.tx = optax.adam(config.lr)
-        self.opt_state = self.tx.init(self.params)
-        self.buffer = ReplayBuffer(
-            config.buffer_capacity, self.obs_size, seed=config.seed
+        # REWIRED (ISSUE 13): the gradient half lives in DQNLearner —
+        # the same object the decoupled dataflow trains through.
+        self.learner = DQNLearner(
+            self.obs_size,
+            self.num_actions,
+            lr=config.lr,
+            gamma=config.gamma,
+            hidden=config.hidden,
+            double_q=config.double_q,
+            buffer_capacity=config.buffer_capacity,
+            train_batch_size=config.train_batch_size,
+            updates_per_batch=config.num_updates_per_iteration,
+            target_update_freq=config.target_update_freq,
+            learning_starts=config.learning_starts,
+            seed=config.seed,
         )
         self.vec = VectorEnv(
             lambda s: make_env(config.env_spec, seed=s),
@@ -163,59 +358,27 @@ class DQN:
         )
         self._obs = self.vec.reset()
         self._rng = np.random.default_rng(config.seed)
-        self._update_jit = jax.jit(self._td_update)
-        self._q_jit = jax.jit(self._q_values)
         self.iteration = 0
         self.env_steps = 0
-        self.updates = 0
         self._ep_returns = np.zeros(config.num_envs)
         self._recent_returns: list = []
 
-    # -- Q function ----------------------------------------------------
-    @staticmethod
-    def _q_values(params, obs):
-        from .models import apply_policy
+    # -- learner views (kept for compatibility) -----------------------
+    @property
+    def params(self):
+        return self.learner.params
 
-        q, _ = apply_policy(params, obs)
-        return q
+    @property
+    def target_params(self):
+        return self.learner.target_params
 
-    def _td_update(self, params, target_params, opt_state, batch):
-        import jax
-        import jax.numpy as jnp
-        import optax
+    @property
+    def buffer(self) -> ReplayBuffer:
+        return self.learner.buffer
 
-        gamma = self.config.gamma
-
-        def loss_fn(p):
-            q = self._q_values(p, batch["obs"])
-            q_taken = jnp.take_along_axis(
-                q, batch["actions"][:, None], axis=1
-            )[:, 0]
-            q_next_target = self._q_values(
-                target_params, batch["next_obs"]
-            )
-            if self.config.double_q:
-                # Double-DQN: online net picks, target net evaluates
-                # (reference: dqn_rainbow_learner.py double_q branch).
-                q_next_online = self._q_values(p, batch["next_obs"])
-                best = jnp.argmax(q_next_online, axis=1)
-            else:
-                best = jnp.argmax(q_next_target, axis=1)
-            next_value = jnp.take_along_axis(
-                q_next_target, best[:, None], axis=1
-            )[:, 0]
-            td_target = batch["rewards"] + gamma * next_value * (
-                1.0 - batch["dones"]
-            )
-            td_target = jax.lax.stop_gradient(td_target)
-            return jnp.mean(
-                optax.huber_loss(q_taken, td_target)
-            )
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = self.tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    @property
+    def updates(self) -> int:
+        return self.learner.updates
 
     # -- acting --------------------------------------------------------
     def _epsilon(self) -> float:
@@ -228,7 +391,7 @@ class DQN:
     def _act(self, obs: np.ndarray) -> np.ndarray:
         eps = self._epsilon()
         greedy = np.asarray(
-            np.argmax(self._q_jit(self.params, obs), axis=1)
+            np.argmax(self.learner._q_jit(self.params, obs), axis=1)
         )
         explore = self._rng.integers(
             0, self.num_actions, size=len(obs)
@@ -238,8 +401,6 @@ class DQN:
 
     # -- one iteration (reference: DQN.training_step) -----------------
     def train(self) -> Dict[str, Any]:
-        import jax
-
         cfg = self.config
         for _ in range(cfg.rollout_length):
             actions = self._act(self._obs)
@@ -258,23 +419,7 @@ class DQN:
                     )
                     self._ep_returns[i] = 0.0
             self._obs = next_obs
-        loss = float("nan")
-        if len(self.buffer) >= cfg.learning_starts:
-            for _ in range(cfg.num_updates_per_iteration):
-                batch = self.buffer.sample(cfg.train_batch_size)
-                device_batch = {
-                    k: np.asarray(v) for k, v in batch.items()
-                }
-                self.params, self.opt_state, loss = self._update_jit(
-                    self.params,
-                    self.target_params,
-                    self.opt_state,
-                    device_batch,
-                )
-                self.updates += 1
-                if self.updates % cfg.target_update_freq == 0:
-                    self.target_params = jax.device_get(self.params)
-            loss = float(loss)
+        loss = self.learner.td_steps(cfg.num_updates_per_iteration)
         self.iteration += 1
         self._recent_returns = self._recent_returns[-100:]
         mean_return = (
@@ -315,11 +460,98 @@ class DQN:
 
         with open(os.path.join(path, "state.pkl"), "rb") as f:
             state = pickle.load(f)
-        self.params = jax.device_put(state["params"])
-        self.target_params = state["target_params"]
+        self.learner.params = jax.device_put(state["params"])
+        self.learner.target_params = state["target_params"]
         self.iteration = state["iteration"]
         self.env_steps = state["env_steps"]
-        self.updates = state["updates"]
+        self.learner.updates = state["updates"]
 
     def stop(self) -> None:
         pass
+
+
+class DecoupledDQN:
+    """DQN rewired onto the decoupled dataflow (ISSUE 13): runner
+    actors explore with engine-served (or runner-local) Q inference
+    and stream transition fragments through the rollout queue into
+    the shared DQNLearner's replay buffer; TD updates and target
+    syncs run while the fleet keeps sampling. Epsilon anneals on the
+    DRIVER's global env-step count and ships with each runner call,
+    so exploration scheduling matches the synchronous loop."""
+
+    def __init__(self, config: DQNConfig):
+        from .dataflow import DataflowConfig, RLDataflow
+
+        self.config = config
+        probe = make_env(config.env_spec, seed=0)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.learner = DQNLearner(
+            self.obs_size,
+            self.num_actions,
+            lr=config.lr,
+            gamma=config.gamma,
+            hidden=config.hidden,
+            double_q=config.double_q,
+            buffer_capacity=config.buffer_capacity,
+            train_batch_size=config.train_batch_size,
+            updates_per_batch=config.num_updates_per_iteration,
+            target_update_freq=config.target_update_freq,
+            learning_starts=config.learning_starts,
+            seed=config.seed,
+        )
+
+        def epsilon(env_steps: int) -> float:
+            frac = min(
+                1.0, env_steps / config.epsilon_decay_steps
+            )
+            return config.epsilon_initial + frac * (
+                config.epsilon_final - config.epsilon_initial
+            )
+
+        self._epsilon_fn = epsilon
+        self.flow = RLDataflow(
+            self.learner,
+            env_spec=config.env_spec,
+            obs_size=self.obs_size,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs,
+            rollout_length=config.rollout_length,
+            gamma=config.gamma,
+            gae_lambda=0.0,  # unused by the dqn fragment path
+            seed=config.seed,
+            algo="dqn",
+            flow=DataflowConfig(
+                policy=config.dataflow_policy,
+                queue_capacity=config.queue_capacity,
+                max_weight_lag=config.max_weight_lag,
+                sync_interval_updates=config.sync_interval_updates,
+            ),
+            epsilon_fn=epsilon,
+        )
+        self.iteration = 0
+
+    @property
+    def env_steps(self) -> int:
+        return self.flow._env_steps
+
+    @property
+    def updates(self) -> int:
+        return self.learner.updates
+
+    def train(self) -> Dict[str, Any]:
+        metrics = self.flow.train_update()
+        self.iteration += 1
+        stats = self.flow.stats()
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": stats["episode_return_mean"],
+            "num_env_steps_sampled": stats["env_steps"],
+            "num_updates": self.learner.updates,
+            "epsilon": self._epsilon_fn(stats["env_steps"]),
+            "td_loss": metrics.get("td_loss", float("nan")),
+            "weight_version": metrics.get("weight_version", 0),
+        }
+
+    def stop(self) -> None:
+        self.flow.shutdown()

@@ -15,6 +15,35 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def compute_gae(
+    rew_buf: np.ndarray,  # [T, N]
+    val_buf: np.ndarray,  # [T, N]
+    done_buf: np.ndarray,  # [T, N] TERMINATIONS only
+    last_values: np.ndarray,  # [N] bootstrap values of obs T
+    gamma: float,
+    lam: float,
+) -> np.ndarray:
+    """The GAE backward pass, shared by the synchronous runner and
+    the decoupled dataflow runner (ISSUE 13: one copy of the math
+    both comparison sides must agree on). Bootstraps through
+    truncation but not termination — `done_buf` carries terminated
+    flags only."""
+    T, N = rew_buf.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    for t in reversed(range(T)):
+        next_value = val_buf[t + 1] if t + 1 < T else last_values
+        nonterminal = 1.0 - done_buf[t].astype(np.float32)
+        delta = (
+            rew_buf[t]
+            + gamma * next_value * nonterminal
+            - val_buf[t]
+        )
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+    return adv
+
+
 class SingleAgentEnvRunner:
     """Actor body: vectorized envs + CPU policy inference."""
 
@@ -87,21 +116,10 @@ class SingleAgentEnvRunner:
         _, _, last_values, self._key = sample_actions(
             self.params, self._obs, self._key
         )
-        adv = np.zeros((T, N), np.float32)
-        last_gae = np.zeros(N, np.float32)
-        for t in reversed(range(T)):
-            next_value = val_buf[t + 1] if t + 1 < T else last_values
-            nonterminal = 1.0 - done_buf[t].astype(np.float32)
-            delta = (
-                rew_buf[t]
-                + self.gamma * next_value * nonterminal
-                - val_buf[t]
-            )
-            last_gae = (
-                delta
-                + self.gamma * self.lam * nonterminal * last_gae
-            )
-            adv[t] = last_gae
+        adv = compute_gae(
+            rew_buf, val_buf, done_buf, last_values,
+            self.gamma, self.lam,
+        )
         returns = adv + val_buf
         flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
         episode_returns = self._finished_returns
@@ -180,10 +198,17 @@ class EnvRunnerGroup:
         return self.manager.num_healthy_actors()
 
     def sync_weights(self, params) -> None:
+        """Broadcast weights with ONE concurrent fan-out (the
+        manager's rt.wait gather — no serial per-runner round-trips;
+        ISSUE 13 satellite). A dead runner never fails the call: its
+        slot is pruned from the healthy set and restored-and-resynced
+        in the same pass (on_restore pushes this very ref)."""
         self._latest_weights_ref = self._rt.put(params)
-        self.manager.foreach_actor(
+        results = self.manager.foreach_actor(
             "set_weights", self._latest_weights_ref, timeout=120
         )
+        if any(not r.ok for r in results):
+            self.manager.probe_unhealthy_actors()
 
     def sample(self) -> Dict[str, np.ndarray]:
         # Heal dead slots from previous iterations first, then accept

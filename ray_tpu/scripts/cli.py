@@ -592,6 +592,22 @@ def cmd_doctor(args) -> None:
         suspect = dag.get("suspect")
         if suspect:
             print(f"  {suspect['detail']}")
+    rl = verdict.get("rl") or {}
+    if rl.get("series"):
+        series = rl["series"]
+        depth = series.get("rl_queue_depth", 0)
+        cap = series.get("rl_queue_capacity", 0)
+        print(
+            "rl dataflow: queue "
+            f"{depth:g}/{cap:g}, env steps "
+            f"{series.get('rl_env_steps_total', 0):g}, learner "
+            f"updates {series.get('rl_learner_updates_total', 0):g}, "
+            f"weight lag {series.get('rl_weight_lag', 0):g}"
+        )
+        print(
+            f"  bottleneck [{rl.get('bottleneck', '?')}]: "
+            f"{rl.get('detail', '')}"
+        )
     if verdict.get("healthy"):
         print("verdict: HEALTHY")
         return

@@ -63,6 +63,8 @@ __all__ = [
     "observe_engine_prefix",
     "observe_engine_ttft",
     "observe_engine_finish",
+    "observe_engine_weights",
+    "observe_engine_policy",
     "deployment_snapshot",
 ]
 
@@ -514,6 +516,58 @@ def observe_engine_finish(tags: Dict[str, str], reason: str) -> None:
             "Requests retired by the engine, by outcome",
             ENGINE_TAGS + ("outcome",),
         ).inc(1.0, tags={**tags, "outcome": reason})
+    except Exception:
+        pass
+
+
+def observe_engine_weights(
+    tags: Dict[str, str], version: int
+) -> None:
+    """Engine: a drainless weight push installed a new generation —
+    the version now served to NEW admissions and policy batches
+    (in-flight streams finish on the generation they pinned). The RL
+    dataflow pairs this with `rl_weight_version`/`rl_weight_lag` from
+    the learner side; the acceptance surface for weight-sync
+    visibility on /metrics."""
+    if not _ENABLED:
+        return
+    try:
+        _gauge(
+            "serve_engine_weight_version",
+            "Weight version served to new engine admissions",
+            ENGINE_TAGS,
+        ).set(float(version), tags=tags)
+        _counter(
+            "serve_engine_weight_updates_total",
+            "Drainless weight pushes installed by the engine",
+            ENGINE_TAGS,
+        ).inc(1.0, tags=tags)
+    except Exception:
+        pass
+
+
+def observe_engine_policy(
+    tags: Dict[str, str], batch_ms: float, rows: int, bucket: int
+) -> None:
+    """Engine: one policy-path batched forward (the non-LLM batch
+    program serving RL action requests)."""
+    if not _ENABLED:
+        return
+    try:
+        _engine_histogram(
+            "serve_engine_policy_batch_ms",
+            "One policy batch-program forward in the engine",
+        ).observe(batch_ms, tags=tags)
+        _engine_histogram(
+            "serve_engine_policy_batch_rows",
+            "Rows served per policy batch (before bucket padding)",
+            boundaries=BATCH_BUCKETS,
+        ).observe(float(rows), tags=tags)
+        _counter(
+            "serve_engine_policy_rows_total",
+            "Policy-path rows served by the engine",
+            ENGINE_TAGS,
+        ).inc(float(rows), tags=tags)
     except Exception:
         pass
 

