@@ -1259,6 +1259,55 @@ def run_micro() -> dict:
         _queue_cycle, 2000
     )
 
+    # 0c. memory-ledger report fold at 10k live objects (ISSUE 14):
+    # the off-path fold every daemon runs each
+    # memory_report_interval_s. Pure host-side bookkeeping, measured
+    # in ms per fold — at the 5 s default interval this must stay
+    # far below 1% of a tick so report overhead is invisible in the
+    # --smoke step medians (the PR 5 flight-recorder bar).
+    from ray_tpu._private.ids import ObjectID as _MLObjectID
+    from ray_tpu._private.ids import TaskID as _MLTaskID
+    from ray_tpu._private.memory_ledger import build_node_report
+
+    _ml_task = _MLTaskID.from_random()
+    _ml_entries = [
+        (
+            _MLObjectID.for_return(_ml_task, i + 1),
+            (i % 64 + 1) * 4096,
+            f"{i % 8:08x}",                # 8 jobs
+            f"task:{i % 200:040x}",        # 200 owners
+            0,                             # no pid probes in the fold
+            100.0,
+            i % 3 == 0,
+            i % 17 == 0,
+            True,
+        )
+        for i in range(10_000)
+    ]
+    _ml_size_info = {
+        "used": sum(e[1] for e in _ml_entries),
+        "capacity": 1 << 34,
+        "num_objects": len(_ml_entries),
+    }
+
+    def _report_fold_trial() -> float:
+        t0 = time.perf_counter()
+        for _ in range(5):
+            build_node_report(
+                "benchnode",
+                _ml_entries,
+                _ml_size_info,
+                {"spilled_bytes": 0, "spilled_objects": 0},
+                topk=20,
+                now=200.0,
+                pid_alive=lambda pid: True,
+            )
+        return (time.perf_counter() - t0) * 1e3 / 5
+
+    results["memory_report_ms"] = _micro_case_from(
+        _report_fold_trial, digits=3
+    )
+
     # 8 CPUs: the suite holds up to 6 live actors (1 latency counter,
     # 4 n:n actors, 1 DAG echo) plus task workers.
     rt.init(num_cpus=8)

@@ -179,6 +179,22 @@ class Config:
     #: Snapshots retained in the head time-series ring (oldest evict
     #: first; 720 x 5 s = a one-hour window by default).
     metrics_timeseries_max_snapshots: int = 720
+    #: Seconds between per-node memory-report folds into the head's
+    #: memory ledger (object attribution, per-job usage, doctor
+    #: verdict.memory); 0 disables the ledger WHOLE — report loops,
+    #: on-demand head folds, chip·s accounting, the rt_job_* /
+    #: rt_object_owner_* series, and verdict.memory all stand down
+    #: (`ray_tpu memory` says so). Off-path like the time-series
+    #: snapshots: the fold reads the object table once per tick,
+    #: never per seal/get.
+    memory_report_interval_s: float = 5.0
+    #: Largest live objects carried per node memory report (the
+    #: `ray_tpu memory` top-objects table; bounds report size).
+    memory_report_topk: int = 20
+    #: `verdict.memory` leak deadline: an object still held this many
+    #: seconds after its creation whose owner process died (or whose
+    #: job ended) is named a leak suspect.
+    doctor_leak_age_s: float = 300.0
     #: Kill switch for the continuous-batching LLM serving engine
     #: (ray_tpu/llm): RT_serve_engine_enabled=0 makes `build_llm_app`
     #: deployments fall back to per-request `generate_stream()` — the

@@ -111,6 +111,17 @@ class ObjectEntry:
     #: gone but the object is still servable locally (reference:
     #: ObjectTableData spilled_url, gcs.proto).
     spilled: bool = False
+    # Owner attribution (reference: ObjectTableData owner/spilled
+    # fields; the memory ledger's per-job accounting rides these).
+    #: Hex job id of the creating client; "" = unattributed.
+    owner_job: str = ""
+    #: Creating context: "driver", "task:<hex>" or "actor:<hex>".
+    owner: str = ""
+    #: Pid of the creating client ON THE SEALING NODE (0 elsewhere);
+    #: probed for liveness by that node's memory report only.
+    owner_pid: int = 0
+    #: Wall time of the first seal (leak-age anchor).
+    created_ts: float = 0.0
 
 
 @dataclass
@@ -382,6 +393,15 @@ class NodeDaemon:
         self._timeseries = TimeSeriesStore(
             config.metrics_timeseries_max_snapshots
         )
+        # Cluster memory & per-job usage ledger (head: aggregates the
+        # per-node reports; every node builds its own report on the
+        # memory-report tick).
+        from .memory_ledger import MemoryLedger
+
+        self._memory_ledger = MemoryLedger(
+            max_owner_series=config.memory_report_topk
+        )
+        self._memory_folded_at = 0.0
         # This process's flight recorder obeys the cluster config
         # (env RT_flight_recorder_enabled already applied at import).
         from .flight_recorder import configure as _flight_configure
@@ -485,6 +505,10 @@ class NodeDaemon:
             "metrics_record",
             "metrics_summary",
             "metrics_timeseries",
+            # memory ledger (reports flow node -> head; the summary
+            # serves `ray_tpu memory` and /api/memory)
+            "memory_report",
+            "memory_summary",
             "event_stats",
             "profile_worker",
             # flight recorder / stall doctor (all nodes; diagnose and
@@ -670,6 +694,13 @@ class NodeDaemon:
                 name=f"hb:{self.node_id.hex()[:8]}",
             )
             self._hb_thread.start()
+        if self.config.memory_report_interval_s > 0:
+            # After the head client exists (worker nodes push their
+            # reports over it); the head folds its own report locally.
+            threading.Thread(
+                target=self._memory_report_loop, daemon=True,
+                name=f"mem:{self.node_id.hex()[:8]}",
+            ).start()
 
     # ------------------------------------------------------------------
     # registration / lifecycle
@@ -1217,7 +1248,8 @@ class NodeDaemon:
     def _h_put_inline(self, conn, msg):
         if not self.is_head:
             return self.head.call(
-                "put_inline", oid=msg["oid"], data=msg["data"]
+                "put_inline", oid=msg["oid"], data=msg["data"],
+                **self._owner_fwd(msg),
             )
         oid = ObjectID(msg["oid"])
         with self._lock:
@@ -1225,9 +1257,41 @@ class NodeDaemon:
             entry.inline = msg["data"]
             entry.size = len(msg["data"])
             entry.state = SEALED
+            self._record_owner(entry, msg, local_pid=False)
         self._wake(oid)
         self._schedule()
         return {}
+
+    @staticmethod
+    def _record_owner(
+        entry: ObjectEntry, msg: dict, local_pid: bool
+    ) -> None:
+        """Adopt owner attribution from a seal/put report (caller
+        holds the lock). First writer wins — a secondary copy's seal
+        must not re-attribute the object — and the owner pid is only
+        meaningful where the creating client actually runs
+        (`local_pid`: the node that took the client's own report)."""
+        if msg.get("owner_job") and not entry.owner_job:
+            entry.owner_job = str(msg["owner_job"])
+            entry.owner = str(msg.get("owner", "") or "")
+            if local_pid:
+                entry.owner_pid = int(msg.get("owner_pid") or 0)
+        if not entry.created_ts:
+            # A pulled secondary copy inherits the primary's creation
+            # time (leak age anchors at first seal, not local arrival).
+            entry.created_ts = float(
+                msg.get("created_ts") or 0.0
+            ) or time.time()
+
+    @staticmethod
+    def _owner_fwd(msg: dict) -> dict:
+        """Owner-attribution fields of a seal/put report, for
+        forwarding to the head."""
+        return {
+            k: msg[k]
+            for k in ("owner_job", "owner", "owner_pid")
+            if k in msg
+        }
 
     def _h_object_sealed(self, conn, msg):
         """A shm object was sealed. From a local worker: record the
@@ -1239,6 +1303,13 @@ class NodeDaemon:
             entry = self._ensure_entry(oid)
             entry.size = msg["size"]
             entry.state = SEALED
+            # Owner pid liveness is only probeable on the node the
+            # creating client runs on — the node taking its direct
+            # report (the head's directory copy keeps job/owner for
+            # attribution, without the pid).
+            self._record_owner(
+                entry, msg, local_pid=source_node is None
+            )
             if source_node is None:
                 entry.in_shm = True  # sealed by a local client
             if self.is_head:
@@ -1247,10 +1318,11 @@ class NodeDaemon:
             # Primary copy: pin against eviction until spilled/deleted.
             self._pin_primary(oid, msg["size"])
         if not self.is_head and source_node is None:
-            # Report our copy to the head's object directory.
+            # Report our copy (with its attribution) to the head's
+            # object directory.
             self.head.call(
                 "object_sealed", oid=msg["oid"], size=msg["size"],
-                node_id=self.node_id.binary(),
+                node_id=self.node_id.binary(), **self._owner_fwd(msg),
             )
         self._wake(oid)
         self._schedule()
@@ -1400,7 +1472,16 @@ class NodeDaemon:
                 info = self.control.nodes.get(NodeID(nid))
                 if info is not None and info.alive:
                     locations.append((nid, info.address))
-            return {"size": entry.size, "locations": locations}
+            # Attribution rides the meta so a pulling node's secondary
+            # copy lands in its arena already attributed (no pid: the
+            # creator doesn't run there, liveness is unknowable).
+            return {
+                "size": entry.size,
+                "locations": locations,
+                "owner_job": entry.owner_job,
+                "owner": entry.owner,
+                "created_ts": entry.created_ts,
+            }
 
     def _h_get_object_meta(self, conn, msg):
         oid = ObjectID(msg["oid"])
@@ -1965,6 +2046,7 @@ class NodeDaemon:
             entry.in_shm = False
         self._unpin_primary(oid)
         self.store.unlink_by_id(oid)
+        self.core_counters.bump("spills")
         return True
 
     def _restore_spilled(self, oid: ObjectID) -> bool:
@@ -2008,6 +2090,7 @@ class NodeDaemon:
             if self.is_head:
                 entry.locations.add(self.node_id.binary())
         self._pin_primary(oid, len(data), pin=pin)
+        self.core_counters.bump("restores")
         return True
 
     # -- cross-node pull -------------------------------------------------
@@ -2122,6 +2205,10 @@ class NodeDaemon:
                     entry.in_shm = True
                     entry.size = size
                     entry.state = SEALED
+                    # The secondary copy fills THIS node's arena: carry
+                    # the owner from the meta so the memory ledger can
+                    # attribute the bytes here too.
+                    self._record_owner(entry, meta, local_pid=False)
                     if self.is_head:
                         entry.locations.add(self.node_id.binary())
                 if not self.is_head:
@@ -3612,6 +3699,9 @@ class NodeDaemon:
         if self._shutdown:
             return
         self.control.mark_node_dead(NodeID(node_id))
+        # Its arena died with it: stop attributing its bytes (the
+        # ledger's byte·s already banked what it consumed while alive).
+        self._memory_ledger.drop_node(NodeID(node_id).hex())
         with self._lock:
             self._node_sync_versions.pop(node_id, None)
         self._pg_on_node_death(node_id)
@@ -4309,25 +4399,50 @@ class NodeDaemon:
 
     def _h_list_objects(self, conn, msg):
         """Node-local object table for the state API (reference:
-        node_manager.cc:780 HandleGetObjectsInfo)."""
+        node_manager.cc:780 HandleGetObjectsInfo). Largest first
+        BEFORE truncating: dict order here is creation order, so a
+        plain [:limit] under load dropped an arbitrary slice — the
+        big consumers an operator is actually after (same bug class
+        as the list_tasks newest-first fix)."""
         limit = int(msg.get("limit", 1000))
+        now = time.time()
+        # Snapshot under the lock, sort + build rows outside it (the
+        # _node_memory_report pattern): the O(N log N) pass over a
+        # large table must not stall the seal/get/schedule hot paths.
         with self._lock:
-            entries = list(self.objects.items())[:limit]
-            out = []
-            for oid, entry in entries:
-                out.append(
-                    {
-                        "object_id": oid.hex(),
-                        "state": entry.state,
-                        "size": entry.size,
-                        "in_shm": entry.in_shm,
-                        "inline": entry.inline is not None,
-                        "locations": [
-                            NodeID(n).hex() for n in entry.locations
-                        ],
-                        "ref_count": entry.refcount,
-                    }
-                )
+            entries = [
+                # locations is a live set: tuple-copy it here so the
+                # row build can't race a concurrent seal's add().
+                (oid, entry, tuple(entry.locations),
+                 oid in self._primary_pins)
+                for oid, entry in self.objects.items()
+            ]
+        entries.sort(key=lambda item: item[1].size, reverse=True)
+        out = []
+        for oid, entry, locations, pinned in entries[:limit]:
+            out.append(
+                {
+                    "object_id": oid.hex(),
+                    "state": entry.state,
+                    "size": entry.size,
+                    "in_shm": entry.in_shm,
+                    "inline": entry.inline is not None,
+                    "locations": [
+                        NodeID(n).hex() for n in locations
+                    ],
+                    "ref_count": entry.refcount,
+                    # Ledger attribution columns (ISSUE 14).
+                    "job": entry.owner_job,
+                    "owner": entry.owner,
+                    "age_s": (
+                        round(now - entry.created_ts, 3)
+                        if entry.created_ts
+                        else 0.0
+                    ),
+                    "spilled": entry.spilled,
+                    "pinned": pinned,
+                }
+            )
         return {"objects": out}
 
     def _h_cluster_load(self, conn, msg):
@@ -4525,13 +4640,17 @@ class NodeDaemon:
             # payload (train/telemetry.py), `value` the step
             # index. Stored whole — skew needs per-step,
             # per-rank records, not aggregates.
-            self._step_records.append(
-                {
-                    "step": int(value),
-                    "time": time.time(),
-                    **{str(k): v for k, v in tags},
-                }
-            )
+            record = {
+                "step": int(value),
+                "time": time.time(),
+                **{str(k): v for k, v in tags},
+            }
+            self._step_records.append(record)
+            # Chip·s accounting accumulates at APPEND time (exact):
+            # the bounded diagnostic ring can evict records between
+            # periodic ledger folds under a fast gang's record rate.
+            if self.config.memory_report_interval_s > 0:
+                self._memory_ledger.add_step(record)
             return
         declared = tuple(rec[4]) if len(rec) > 4 else ()
         tags = tuple(tuple(t) for t in tags)
@@ -4875,6 +4994,11 @@ class NodeDaemon:
                 total = sum(values.values())
             entry["total" if kind == "counter" else "value"] = total
             out[name] = entry
+        # Memory-ledger series (rt_job_*, rt_object_owner_*): shaped
+        # like table entries so the Prometheus exposition and the
+        # time-series snapshot loop pick them up without new plumbing.
+        self._refresh_memory_ledger()
+        out.update(self._memory_ledger.metric_entries())
         return {"metrics": out}
 
     def _timeseries_loop(self) -> None:
@@ -4953,6 +5077,127 @@ class NodeDaemon:
             "interval_s": self.config.metrics_timeseries_interval_s,
             "max_snapshots": self._timeseries.max_snapshots,
         }
+
+    # ------------------------------------------------------------------
+    # memory ledger (reference: `ray memory` over ObjectTableData +
+    # util/state/memory_utils.py; the fold is off-path like the
+    # time-series snapshots — no per-seal/per-get work)
+    # ------------------------------------------------------------------
+    def _node_memory_report(self) -> dict:
+        """Fold THIS node's object table into a compact memory report
+        (memory_ledger.build_node_report). The lock is held only for
+        the tuple snapshot; the fold (size sort, pid probes) runs
+        outside it."""
+        from .memory_ledger import build_node_report
+
+        with self._lock:
+            entries = [
+                (
+                    oid,
+                    e.size,
+                    e.owner_job,
+                    e.owner,
+                    e.owner_pid,
+                    e.created_ts,
+                    oid in self._primary_pins,
+                    e.spilled,
+                    e.in_shm,
+                )
+                for oid, e in self.objects.items()
+                if e.in_shm or e.spilled
+            ]
+        counters = self.core_counters
+        return build_node_report(
+            self.node_id.hex(),
+            entries,
+            self.store.size_info(),
+            self.spill.stats() if self.spill is not None else None,
+            spill_ops=counters.spills,
+            restore_ops=counters.restores,
+            topk=self.config.memory_report_topk,
+        )
+
+    def _memory_report_loop(self) -> None:
+        """Every node: fold the local object table into a report each
+        `memory_report_interval_s`. Worker nodes push theirs to the
+        head (batched off-path, like the metrics pipe); the head folds
+        its own straight into the ledger."""
+        interval = self.config.memory_report_interval_s
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                if self.is_head:
+                    self._refresh_memory_ledger(max_age_s=0.0)
+                elif self.head is not None:
+                    self.head.call(
+                        "memory_report",
+                        report=self._node_memory_report(),
+                        timeout=30.0,
+                    )
+            except Exception:
+                # A missed tick is a stale report, never a crash; the
+                # next tick re-folds.
+                pass
+
+    def _refresh_memory_ledger(self, max_age_s: float = 1.0) -> None:
+        """Head only: fold the head's own report into the ledger,
+        rate-limited by `max_age_s` so on-demand readers
+        (metrics_summary, doctor) stay fresh without re-folding per
+        poll. Chip·s accumulates separately, at step-record append
+        (`_apply_metric_record`). `memory_report_interval_s=0` is a
+        REAL kill switch: on-demand folds stand down too — worker
+        nodes aren't reporting, so a head-only fold would dress a
+        half-blind ledger up as cluster truth."""
+        if not self.is_head or self.config.memory_report_interval_s <= 0:
+            return
+        now = time.time()
+        if now - self._memory_folded_at < max_age_s:
+            return
+        self._memory_folded_at = now
+        self._memory_ledger.fold(self._node_memory_report())
+
+    def _h_memory_report(self, conn, msg):
+        """A worker node's periodic memory report (head only; ignored
+        when the head's ledger is disabled so a mixed-config cluster
+        can't half-populate it)."""
+        if not self.is_head or self.config.memory_report_interval_s <= 0:
+            return {}
+        self._memory_ledger.fold(dict(msg["report"]))
+        return {}
+
+    def _h_memory_summary(self, conn, msg):
+        """The cluster memory view `ray_tpu memory` / `/api/memory`
+        serve: totals + attribution, per-job usage, per-owner bytes,
+        top objects, per-node reports, and the doctor's
+        `verdict.memory` over the same data."""
+        if not self.is_head:
+            return self.head.call("memory_summary", timeout=30.0)
+        self._refresh_memory_ledger()
+        summary = self._memory_ledger.summary()
+        summary["verdict"] = self._memory_verdict()
+        if self.config.memory_report_interval_s <= 0:
+            summary["disabled"] = True
+        return {"memory": summary}
+
+    def _memory_verdict(
+        self, leak_age_s: Optional[float] = None
+    ) -> dict:
+        """`verdict.memory` over the ledger (head only): nodes near
+        capacity, leak suspects past the leak deadline, spill
+        thrash."""
+        ended = {
+            info.job_id.hex()
+            for info in self.control.jobs.values()
+            if info.end_time is not None
+        }
+        return self._memory_ledger.verdict(
+            leak_age_s=(
+                self.config.doctor_leak_age_s
+                if leak_age_s is None
+                else float(leak_age_s)
+            ),
+            job_ended=lambda job: job in ended,
+        )
 
     def _h_task_event(self, conn, msg):
         """Workers report state events for direct-transport tasks
@@ -5141,6 +5386,7 @@ class NodeDaemon:
                     "straggler_threshold",
                     "capture_stacks",
                     "limit",
+                    "leak_age_s",
                 )
                 if k in msg
             }
@@ -5196,6 +5442,44 @@ class NodeDaemon:
         # Decoupled-RL dataflow: queue levels/gates + weight versions
         # folded into an actor-vs-learner bottleneck attribution.
         rl = self._rl_summary()
+        # Memory ledger: near-capacity nodes, leak suspects past the
+        # leak deadline, spill thrash — each promoted to a problem so
+        # the exit-code contract covers memory health too.
+        leak_age_s = float(
+            msg.get("leak_age_s", self.config.doctor_leak_age_s)
+        )
+        self._refresh_memory_ledger(max_age_s=0.0)
+        memory = self._memory_verdict(leak_age_s=leak_age_s)
+        for row in memory.get("near_capacity", ()):
+            problems.append(
+                {
+                    "kind": "node_near_capacity",
+                    "node_id": row["node"],
+                    "fraction": row["fraction"],
+                    "detail": row["detail"],
+                }
+            )
+        for row in memory.get("leak_suspects", ()):
+            problems.append(
+                {
+                    "kind": "object_leak",
+                    "object_id": row["object_id"],
+                    "node_id": row["node"],
+                    "job": row["job"],
+                    "owner": row["owner"],
+                    "size": row["size"],
+                    "age_s": row["age_s"],
+                    "detail": row["detail"],
+                }
+            )
+        for row in memory.get("spill_thrash", ()):
+            problems.append(
+                {
+                    "kind": "spill_thrash",
+                    "node_id": row["node"],
+                    "detail": row["detail"],
+                }
+            )
         workers = steps.get("workers", {})
         if len(workers) >= 2:
             medians = sorted(
@@ -5436,6 +5720,7 @@ class NodeDaemon:
                 "steps": steps,
                 "dag": dag,
                 "rl": rl,
+                "memory": memory,
                 "rpc": ring_digests,
                 "nodes": {
                     "total": summary["nodes"],
@@ -5444,6 +5729,7 @@ class NodeDaemon:
                 "params": {
                     "hung_task_s": hung_s,
                     "straggler_threshold": threshold,
+                    "leak_age_s": leak_age_s,
                 },
             }
         }

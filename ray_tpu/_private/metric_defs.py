@@ -63,6 +63,8 @@ CORE_METRICS: Dict[str, tuple] = {
     "rt_object_store_objects": ("gauge", "objects", "Objects resident in the local arena"),
     "rt_objects_spilled": ("gauge", "objects", "Objects currently spilled to disk"),
     "rt_spilled_bytes": ("gauge", "bytes", "Bytes currently spilled to disk"),
+    "rt_object_spills_total": ("counter", "spills", "Objects written to spill storage"),
+    "rt_object_restores_total": ("counter", "restores", "Spilled objects restored into the arena"),
     "rt_object_pulls_total": ("counter", "pulls", "Cross-node object pulls started"),
     "rt_object_pull_chunks_total": ("counter", "chunks", "Object chunks fetched from remote nodes"),
     "rt_object_pushes_total": ("counter", "pushes", "Object chunks served to remote nodes"),
@@ -104,6 +106,8 @@ class CoreCounters:
         "pull_chunks",
         "pushes",
         "heartbeats",
+        "spills",
+        "restores",
     )
 
     def __init__(self):
@@ -250,6 +254,8 @@ def collect(daemon) -> Dict[str, float]:
     )
     out["rt_workers_oom_killed_total"] = float(c.get("oom_kills", 0))
     out["rt_lease_requests_total"] = float(c.get("lease_requests", 0))
+    out["rt_object_spills_total"] = float(c.get("spills", 0))
+    out["rt_object_restores_total"] = float(c.get("restores", 0))
     out["rt_object_pulls_total"] = float(c.get("pulls", 0))
     out["rt_object_pull_chunks_total"] = float(c.get("pull_chunks", 0))
     out["rt_object_pushes_total"] = float(c.get("pushes", 0))

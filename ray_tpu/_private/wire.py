@@ -439,9 +439,16 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "kv_del": {"key": (str, bytes), "?ns": str},
     "kv_keys": {"?prefix": (str, bytes), "?ns": str},
     # object plane
-    "put_inline": {"oid": bytes, "data": bytes},
+    # Owner-attribution fields on seal/put reports feed the memory
+    # ledger: job hex, creating context ("driver"/"task:…"/"actor:…"),
+    # and the creator's pid (probed for leak liveness node-locally).
+    "put_inline": {
+        "oid": bytes, "data": bytes,
+        "?owner_job": str, "?owner": str, "?owner_pid": int,
+    },
     "object_sealed": {
         "oid": bytes, "size": int, "?node_id": (bytes, type(None)),
+        "?owner_job": str, "?owner": str, "?owner_pid": int,
     },
     "seal_error": {"oid": bytes, "error": bytes},
     "get_object": {"oid": bytes},
@@ -521,6 +528,9 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?seq": (int, type(None)),
     },
     "metrics_summary": {},
+    # memory ledger: per-node reports up, cluster view down
+    "memory_report": {"report": dict},
+    "memory_summary": {},
     "metrics_timeseries": {
         "?name": (str, type(None)),
         "?since": _num,
@@ -537,7 +547,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "step_summary": {"?limit": int, "?records": bool},
     "diagnose": {
         "?hung_task_s": _num, "?straggler_threshold": _num,
-        "?capture_stacks": bool, "?limit": int,
+        "?capture_stacks": bool, "?limit": int, "?leak_age_s": _num,
     },
     # pubsub / log streaming
     "subscribe_logs": {"?channels": list},

@@ -638,6 +638,29 @@ class CoreWorker:
                     time.sleep(0.05 * attempt)
         raise last
 
+    def _owner_fields(self, oid: Optional[ObjectID] = None) -> dict:
+        """Owner attribution riding every seal/put report (the memory
+        ledger's per-job accounting): job hex plus the creating
+        context — the executing actor, the executing task, or the
+        driver itself — and this process's pid for node-local leak
+        liveness probes. Direct-transport results are sealed after
+        the task context is already cleared, so a worker process
+        falls back to the creating task the oid itself embeds
+        (ObjectID.for_return/for_put carry it)."""
+        if self._actor_id is not None:
+            owner = "actor:" + self._actor_id.hex()
+        elif self._ctx.task_id is not None:
+            owner = "task:" + self._ctx.task_id.hex()
+        elif self.role == "worker" and oid is not None:
+            owner = "task:" + oid.task_id().hex()
+        else:
+            owner = "driver"
+        return {
+            "owner_job": self.job_id.hex(),
+            "owner": owner,
+            "owner_pid": os.getpid(),
+        }
+
     def _seal_and_report(self, oid: ObjectID, used: int) -> None:
         """Seal a just-written object and report it to the daemon. On
         the shared arena the seal takes a creator pin held until the
@@ -652,7 +675,8 @@ class CoreWorker:
             self.store.seal(oid)
         try:
             self._client.call(
-                "object_sealed", oid=oid.binary(), size=used
+                "object_sealed", oid=oid.binary(), size=used,
+                **self._owner_fields(oid),
             )
         finally:
             if pin is not None:
@@ -704,7 +728,10 @@ class CoreWorker:
                     self._inline_cache[oid] = data
             # Async registration: the daemon's deferred-waiter get path
             # answers anyone who asks before the notify lands.
-            self._client.notify("put_inline", oid=oid.binary(), data=data)
+            self._client.notify(
+                "put_inline", oid=oid.binary(), data=data,
+                **self._owner_fields(oid),
+            )
             return ("inline", data)
         # Large object: flush deferred ref-drops first so the daemon's
         # eviction view is current when space is tight.

@@ -193,19 +193,25 @@ def diagnose(
     hung_task_s: Optional[float] = None,
     straggler_threshold: Optional[float] = None,
     capture_stacks: bool = True,
+    leak_age_s: Optional[float] = None,
 ) -> dict:
     """Stall doctor: one verdict over head task state, per-worker
     in-flight views, step telemetry, and flight-recorder digests —
     stragglers (worker median step time > cluster p50 × threshold),
     hung tasks (in flight past the deadline, stack auto-captured via
-    the profile relay), unresponsive workers, dead nodes. The CLI
-    surface is `ray_tpu doctor`; thresholds default to the cluster
-    config (`doctor_hung_task_s`, `doctor_straggler_threshold`)."""
+    the profile relay), unresponsive workers, dead nodes — plus
+    `verdict.memory`: nodes near arena capacity, object-leak
+    suspects held past `leak_age_s` by dead owners, and spill
+    thrash. The CLI surface is `ray_tpu doctor`; thresholds default
+    to the cluster config (`doctor_hung_task_s`,
+    `doctor_straggler_threshold`, `doctor_leak_age_s`)."""
     kwargs: Dict[str, Any] = {"capture_stacks": capture_stacks}
     if hung_task_s is not None:
         kwargs["hung_task_s"] = float(hung_task_s)
     if straggler_threshold is not None:
         kwargs["straggler_threshold"] = float(straggler_threshold)
+    if leak_age_s is not None:
+        kwargs["leak_age_s"] = float(leak_age_s)
     # Step records may still sit in this process's metrics buffer.
     # Best-effort: a doctor run against a sick cluster must not die
     # on the flush that the verdict would have explained.

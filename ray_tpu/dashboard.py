@@ -12,6 +12,8 @@ Endpoints: /            — HTML summary page (auto-refreshing)
            /api/placement_groups | /api/resources | /api/metrics
            /api/serve   — per-deployment serving stats (p50/p99,
                           in-flight, queue depth)
+           /api/memory  — cluster memory ledger (per-job/-owner
+                          attribution, leak suspects, verdict.memory)
            /api/timeseries?name=...&since=...&limit=...
                         — head snapshot-ring history
            /metrics     — Prometheus exposition text (0.0.4)
@@ -59,8 +61,9 @@ _PAGE = """<!doctype html>
  <div id="view"></div>
 </main>
 <script>
-const TABS = ["nodes","actors","tasks","objects","placement_groups",
-              "resources","metrics","serve","spans","steps","doctor"];
+const TABS = ["nodes","actors","tasks","objects","memory",
+              "placement_groups","resources","metrics","serve",
+              "spans","steps","doctor"];
 let active = "nodes";
 const $ = (id) => document.getElementById(id);
 function tabs() {
@@ -108,7 +111,25 @@ async function tick() {
        <div class="k">${esc(k)}</div></div>`).join("");
     const data = await j("/api/" + tab);
     if (tab !== active) return;
-    $("view").innerHTML = table(
+    if (tab === "memory") {
+      // Nested payload (lists inside a dict): one table per section,
+      // not the flat spread — spreading an array makes index columns.
+      const v = data.verdict || {};
+      const problems = ["near_capacity","leak_suspects","spill_thrash"]
+        .flatMap(k => (v[k]||[]).map(p => ({kind:k, ...p})));
+      $("view").innerHTML =
+        "<h3>totals</h3>" + table(
+          {...(data.totals||{}), ...(data.disabled?{disabled:true}:{})}) +
+        "<h3>jobs</h3>" + table(
+          Object.entries(data.jobs||{}).map(([k,r]) => ({job:k, ...r}))) +
+        "<h3>owners</h3>" + table(data.owners||[]) +
+        "<h3>nodes</h3>" + table((data.nodes||[]).map(n => ({
+          node:n.node, arena_used:n.arena_used,
+          arena_capacity:n.arena_capacity, objects:n.tracked_objects,
+          attributed:n.attributed_bytes, spilled:n.spilled_bytes}))) +
+        "<h3>top objects</h3>" + table(data.top_objects||[]) +
+        "<h3>verdict</h3>" + table(problems);
+    } else $("view").innerHTML = table(
       tab === "resources" || tab === "metrics" || tab === "steps" ||
       tab === "serve"
         ? Object.entries(data).map(([k,v]) => ({name:k, ...(
@@ -179,6 +200,7 @@ class Dashboard:
                 "available": ray_tpu.available_resources(),
             },
             "metrics": self._metrics,
+            "memory": self._memory,
             "serve": self._serve,
             "spans": self._spans,
             "steps": self._steps,
@@ -196,6 +218,15 @@ class Dashboard:
         from .util.metrics import metrics_summary
 
         return metrics_summary()
+
+    @staticmethod
+    def _memory():
+        """/api/memory — the cluster memory ledger: per-job/-owner
+        attribution, top objects, per-node reports, verdict.memory
+        (see `ray_tpu memory`)."""
+        from .util.state import memory_summary
+
+        return memory_summary()
 
     @staticmethod
     def _serve():

@@ -3,7 +3,7 @@
 Two layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
 `--json`, exit 0/1/2):
 
-* `ray_tpu lint [paths]` — per-file, syntactic (rules RT001-RT009 in
+* `ray_tpu lint [paths]` — per-file, syntactic (rules RT001-RT010 in
   devtools/rules.py; engine in devtools/lint.py). "Is this line an
   idiom this codebase has shipped bugs with?"
 * `ray_tpu check [paths]` — whole-program, two-phase (symbol table in

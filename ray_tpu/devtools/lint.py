@@ -7,7 +7,7 @@ gets inside actors, nondeterminism on replayable paths — live in
 Python and slip past generic linters because they are framework
 idioms, not syntax errors. This module is a purpose-built AST pass
 over ray_tpu's own conventions: one parse per file, one walk, every
-registered rule (devtools/rules.py, RT001–RT009) dispatched from the
+registered rule (devtools/rules.py, RT001–RT010) dispatched from the
 same visitor with shared scope context.
 
 Suppressions: a finding is dropped when its physical line carries
@@ -312,7 +312,7 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         prog="ray_tpu lint",
         description=(
             "framework-aware distributed-correctness linter "
-            "(rules RT001-RT009; suppress with '# rt: noqa[RTxxx]')"
+            "(rules RT001-RT010; suppress with '# rt: noqa[RTxxx]')"
         ),
     )
     parser.add_argument(

@@ -1,4 +1,4 @@
-"""The RT001–RT009 distributed-correctness passes.
+"""The RT001–RT010 distributed-correctness passes.
 
 Each rule is one bug class ray_tpu has actually shipped (or nearly
 shipped — see ADVICE.md for the originals) generalized into a
@@ -18,6 +18,7 @@ leaves the rest of Python alone.
 | RT007 | bare/swallowed exceptions in daemon RPC handlers             |
 | RT008 | cross-process wait()/join() with no timeout                  |
 | RT009 | metric names/labels violating the Prometheus convention      |
+| RT010 | unbounded-cardinality metric labels (per-request/object ids) |
 
 Hooks a rule may define (all optional): ``on_call``, ``on_compare``,
 ``on_except``, ``on_assign``, ``on_keyword``, ``on_functiondef`` —
@@ -481,6 +482,70 @@ class MetricNamingConvention(Rule):
                     )
 
 
+class UnboundedMetricLabels(Rule):
+    """RT010: a metric label whose value is a per-request identity
+    (request id, object id, task id, …) mints one Prometheus series
+    per id — the head's aggregate table and every scrape grow without
+    bound, and no PromQL aggregation wants the id anyway. The memory
+    ledger deliberately exports only top-K owners for exactly this
+    reason; per-id detail belongs in the state API
+    (`ray_tpu state ls objects`), traces, or the flight recorder.
+    Scope: metric declarations (`tag_keys=`) and record sites
+    (`.inc/.set/.observe(tags={...})`) in the package."""
+
+    id = "RT010"
+    title = "unbounded-cardinality metric label (per-request/object id)"
+    exclude = ("tests/",)
+
+    _CONSTRUCTORS = ("Counter", "Gauge", "Histogram")
+    _RECORDERS = ("inc", "set", "observe")
+    #: Label keys whose values are per-entity identities. `job` is
+    #: deliberately absent: jobs are few and the ledger/goodput series
+    #: key on them by design.
+    _BANNED = re.compile(
+        r"^(request|object|task|actor|worker|span|trace|lease|"
+        r"session|batch)_?id$|^(oid|tid|rid)$"
+    )
+
+    def _flag(self, key: str, where: str, anchor) -> Iterable[Hit]:
+        if isinstance(key, str) and self._BANNED.match(key):
+            yield (
+                f"metric label {key!r} {where} is a per-entity id — "
+                "one exported series per id grows the head table and "
+                "every scrape without bound; aggregate (top-K, "
+                "totals) or move per-id detail to the state "
+                "API/traces",
+                anchor,
+            )
+
+    def on_call(self, node: ast.Call, ctx: LintContext) -> Iterable[Hit]:
+        name = _terminal_name(node.func)
+        if name in self._CONSTRUCTORS:
+            for kw in node.keywords:
+                if kw.arg != "tag_keys" or not isinstance(
+                    kw.value, (ast.Tuple, ast.List)
+                ):
+                    continue
+                for element in kw.value.elts:
+                    if isinstance(element, ast.Constant):
+                        yield from self._flag(
+                            element.value,
+                            f"declared on {name}()",
+                            element,
+                        )
+        elif name in self._RECORDERS:
+            for kw in node.keywords:
+                if kw.arg != "tags" or not isinstance(
+                    kw.value, ast.Dict
+                ):
+                    continue
+                for key in kw.value.keys:
+                    if isinstance(key, ast.Constant):
+                        yield from self._flag(
+                            key.value, f"passed to .{name}()", key
+                        )
+
+
 ALL_RULES = [
     BlockingGetInActor(),
     PayloadEqualityDedup(),
@@ -491,4 +556,5 @@ ALL_RULES = [
     SwallowedHandlerError(),
     MissingWaitTimeout(),
     MetricNamingConvention(),
+    UnboundedMetricLabels(),
 ]

@@ -275,35 +275,105 @@ def cmd_logs(args) -> None:
     print(client.get_job_logs(args.job_id), end="")
 
 
-def cmd_memory(args) -> None:
-    """Object-store usage (reference: `ray memory` — the object table
-    with sizes grouped by node, util/state/memory_utils.py)."""
-    _connect(args)
-    from ..util import state
-
-    rows = state.list_objects(limit=args.limit)
-    by_node = {}
-    total = 0
-    for row in rows:
-        node = (row.get("node_id") or "?")[:12]
-        size = int(row.get("size") or 0)
-        total += size
-        agg = by_node.setdefault(node, {"objects": 0, "bytes": 0})
-        agg["objects"] += 1
-        agg["bytes"] += size
-    note = (
-        f" (truncated at --limit {args.limit})"
-        if len(rows) >= args.limit
-        else ""
+def _memory_problems(verdict: dict) -> list:
+    """Flatten `verdict.memory` into the problem rows the exit-code
+    contract counts (shared by `memory` and the doctor's summary)."""
+    return (
+        list(verdict.get("near_capacity") or ())
+        + list(verdict.get("leak_suspects") or ())
+        + list(verdict.get("spill_thrash") or ())
     )
-    print(f"{len(rows)} objects, {total / 1e6:.1f} MB total{note}")
-    for node, agg in sorted(by_node.items()):
+
+
+def cmd_memory(args) -> None:
+    """`ray_tpu memory` — the cluster memory ledger (reference: `ray
+    memory`, util/state/memory_utils.py, grown per-job): top consumers
+    by job/node/owner, per-job bytes·s and chip·s, leak suspects and
+    near-capacity nodes. Exit-code contract matches lint/check/doctor:
+    0 healthy, 1 when `verdict.memory` has findings."""
+    _connect(args)
+    from ..util.state import memory_summary
+
+    mem = memory_summary()
+    verdict = mem.get("verdict") or {}
+    problems = _memory_problems(verdict)
+    if args.as_json:
+        print(json.dumps(mem, indent=2, default=str))
+        sys.exit(1 if problems else 0)
+    if mem.get("disabled"):
         print(
-            f"  node {node}: {agg['objects']} objects, "
-            f"{agg['bytes'] / 1e6:.1f} MB"
+            "memory ledger disabled (memory_report_interval_s=0) — "
+            "no attribution, series, or verdict.memory"
+        )
+        return
+    totals = mem.get("totals") or {}
+    used = totals.get("arena_used", 0)
+    capacity = totals.get("arena_capacity", 0)
+    jobs = mem.get("jobs") or {}
+    nodes = mem.get("nodes") or []
+    n_objects = sum(n.get("tracked_objects", 0) for n in nodes)
+    print(
+        f"{n_objects} objects, {used / 1e6:.1f} / "
+        f"{capacity / 1e6:.0f} MB arena in use across "
+        f"{len(nodes)} node(s), "
+        f"{totals.get('spilled_bytes', 0) / 1e6:.1f} MB spilled"
+    )
+    print(
+        f"attributed to (job, owner) pairs: "
+        f"{totals.get('attributed_bytes', 0) / 1e6:.1f} MB "
+        f"({100.0 * totals.get('attribution_fraction', 0.0):.1f}% "
+        "of arena-used bytes)"
+    )
+    for job, row in sorted(
+        jobs.items(),
+        key=lambda kv: kv[1].get("object_bytes", 0),
+        reverse=True,
+    ):
+        extras = []
+        if "object_byte_seconds" in row:
+            extras.append(
+                f"{row['object_byte_seconds'] / 1e9:.2f} GB·s"
+            )
+        if "chip_seconds" in row:
+            extras.append(f"{row['chip_seconds']:.1f} chip·s")
+        suffix = f" ({', '.join(extras)})" if extras else ""
+        print(
+            f"  job {job}: {row.get('object_bytes', 0) / 1e6:.1f} MB "
+            f"in {row.get('objects', 0)} objects, "
+            f"{row.get('pinned_objects', 0)} pinned{suffix}"
+        )
+    for node in nodes:
+        print(
+            f"  node {node.get('node', '?')[:12]}: "
+            f"{node.get('arena_used', 0) / 1e6:.1f} / "
+            f"{node.get('arena_capacity', 0) / 1e6:.0f} MB, "
+            f"{node.get('tracked_objects', 0)} objects, "
+            f"{node.get('spilled_objects', 0)} spilled"
         )
     if args.verbose:
-        print(json.dumps(rows, indent=2, default=str))
+        print("top owners:")
+        for row in mem.get("owners", []):
+            print(
+                f"  job {row['job']} {row['owner']}: "
+                f"{row['bytes'] / 1e6:.1f} MB in "
+                f"{row['objects']} objects"
+            )
+        print("top objects:")
+        for row in mem.get("top_objects", []):
+            print(
+                f"  {row['object_id'][:16]} {row['size'] / 1e6:.1f} MB "
+                f"job={row.get('job', '')} owner={row.get('owner', '')} "
+                f"age={row.get('age_s', 0):.0f}s"
+                f"{' pinned' if row.get('pinned') else ''}"
+                f"{' spilled' if row.get('spilled') else ''}"
+            )
+    if not problems:
+        print("memory verdict: HEALTHY")
+        return
+    print(f"memory verdict: {len(problems)} finding(s)")
+    for problem in problems:
+        print(f"  {problem.get('detail')}")
+    sys.exit(1)
 
 
 def cmd_timeline(args) -> None:
@@ -554,6 +624,7 @@ def cmd_doctor(args) -> None:
         hung_task_s=args.hung_task_s,
         straggler_threshold=args.straggler_threshold,
         capture_stacks=not args.no_stacks,
+        leak_age_s=args.leak_age_s,
     )
     if args.trace:
         # One chrome trace out of all three streams: task slices
@@ -608,6 +679,17 @@ def cmd_doctor(args) -> None:
             f"  bottleneck [{rl.get('bottleneck', '?')}]: "
             f"{rl.get('detail', '')}"
         )
+    memory = verdict.get("memory") or {}
+    if memory:
+        print(
+            "memory: "
+            f"{100.0 * memory.get('attribution_fraction', 0.0):.0f}% "
+            "of arena bytes attributed, "
+            f"{len(memory.get('leak_suspects') or ())} leak "
+            "suspect(s), "
+            f"{len(memory.get('near_capacity') or ())} node(s) near "
+            "capacity"
+        )
     if verdict.get("healthy"):
         print("verdict: HEALTHY")
         return
@@ -624,7 +706,7 @@ def cmd_doctor(args) -> None:
 
 def cmd_lint(args) -> None:
     """`ray_tpu lint [paths]` — the framework-aware distributed-
-    correctness linter (devtools/lint.py, rules RT001-RT009). Runs
+    correctness linter (devtools/lint.py, rules RT001-RT010). Runs
     offline on source trees; no cluster connection."""
     from ..devtools.lint import main as lint_main
 
@@ -766,11 +848,20 @@ def main(argv=None) -> None:
     p_logs.set_defaults(fn=cmd_logs)
 
     p_mem = sub.add_parser(
-        "memory", help="object-store usage by node"
+        "memory",
+        help="cluster memory ledger: usage by job/node/owner, leak "
+        "suspects (exit 1 on memory findings)",
     )
     p_mem.add_argument("--address")
-    p_mem.add_argument("--limit", type=int, default=10000)
-    p_mem.add_argument("-v", "--verbose", action="store_true")
+    p_mem.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the ledger summary as JSON (CI mode; exit 1 on "
+        "memory findings)",
+    )
+    p_mem.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also print the top-owner and top-object tables",
+    )
     p_mem.set_defaults(fn=cmd_memory)
 
     p_tl = sub.add_parser(
@@ -886,6 +977,11 @@ def main(argv=None) -> None:
         "this factor is a straggler (default: cluster config)",
     )
     p_doc.add_argument(
+        "--leak-age-s", type=float, default=None,
+        help="an object held past this age by a dead owner is a "
+        "leak suspect (default: cluster config doctor_leak_age_s)",
+    )
+    p_doc.add_argument(
         "--no-stacks", action="store_true",
         help="skip auto-capturing stack dumps of hung tasks' workers",
     )
@@ -898,7 +994,7 @@ def main(argv=None) -> None:
 
     p_lint = sub.add_parser(
         "lint",
-        help="distributed-correctness linter (rules RT001-RT009)",
+        help="distributed-correctness linter (rules RT001-RT010)",
     )
     p_lint.add_argument(
         "paths", nargs="*", help="files/dirs to lint (default: ray_tpu)"

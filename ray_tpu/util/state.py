@@ -48,7 +48,25 @@ def list_tasks(limit: int = 1000) -> List[dict]:
 
 
 def list_objects(limit: int = 1000) -> List[dict]:
-    return _worker().call("list_objects", limit=limit)["objects"]
+    """Cluster object table, size-descending. The head sorts BEFORE
+    applying `limit` (the old dict-order truncation dropped an
+    arbitrary slice — the big consumers an operator is after; same
+    bug class as the list_tasks newest-first fix). Rows carry the
+    ledger's attribution columns: job, owner, age_s, spilled,
+    pinned."""
+    rows = _worker().call("list_objects", limit=limit)["objects"]
+    # Defensive re-sort: a pre-ledger head returns creation order.
+    rows.sort(key=lambda r: int(r.get("size") or 0), reverse=True)
+    return rows
+
+
+def memory_summary() -> dict:
+    """The cluster memory ledger (`ray_tpu memory` / `/api/memory`):
+    arena totals + per-job attribution, per-(job, owner) bytes, top
+    objects, per-node reports, spill/restore rates, and the doctor's
+    `verdict.memory` (near-capacity nodes, leak suspects, spill
+    thrash) over the same data."""
+    return _worker().call("memory_summary", timeout=30.0)["memory"]
 
 
 def list_placement_groups() -> List[dict]:
@@ -104,6 +122,7 @@ __all__ = [
     "list_tasks",
     "list_objects",
     "list_placement_groups",
+    "memory_summary",
     "summarize",
     "event_stats",
     "profile_worker",

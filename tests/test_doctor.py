@@ -37,6 +37,13 @@ def test_diagnose_healthy_cluster(rt_session):
     assert verdict["problems"] == []
     assert verdict["nodes"]["alive"] >= 1
     assert "params" in verdict
+    assert verdict["params"]["leak_age_s"] == 300.0
+    # verdict.memory rides every diagnosis; a healthy cluster has no
+    # memory findings.
+    memory = verdict["memory"]
+    assert memory["leak_suspects"] == []
+    assert memory["near_capacity"] == []
+    assert memory["spill_thrash"] == []
 
 
 def test_diagnose_flags_straggler_rank(rt_session):

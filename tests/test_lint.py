@@ -4,7 +4,7 @@ classes that motivated it (ADVICE round 5: tcp_channel payload-dedup,
 autoscaler request packing, worker namespace pinning, sdk num_cpus
 truncation).
 
-Every rule RT001-RT009 has a positive fixture (must fire) and a
+Every rule RT001-RT010 has a positive fixture (must fire) and a
 negative fixture (must stay quiet); the repo lints itself clean — so
 a new framework idiom either passes the rules or carries an explicit
 `# rt: noqa[RTxxx]` reviewed in the diff.
@@ -244,6 +244,46 @@ CASES = [
         """,
         False,
     ),
+    (
+        "RT010",
+        "serve/metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter
+
+        requests = Counter(
+            "serve_requests_total", tag_keys=("app", "request_id")
+        )
+        """,
+        True,
+    ),
+    (
+        "RT010",
+        "llm/engine_mod.py",
+        """
+        from ray_tpu.util.metrics import Gauge
+
+        def record(gauge, oid, nbytes):
+            gauge.set(nbytes, tags={"object_id": oid})
+        """,
+        True,
+    ),
+    (
+        "RT010",
+        "serve/metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        requests = Counter(
+            "serve_requests_total", tag_keys=("app", "deployment")
+        )
+
+        def record(hist, job, ms):
+            # job labels are bounded by design (goodput/ledger key
+            # on them); ids are what RT010 rejects.
+            hist.observe(ms, tags={"job": job})
+        """,
+        False,
+    ),
 ]
 
 
@@ -360,7 +400,7 @@ def test_every_rule_has_id_title_and_doc():
     from ray_tpu.devtools.rules import ALL_RULES
 
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 10)]
+    assert ids == [f"RT{i:03d}" for i in range(1, 11)]
     for rule in ALL_RULES:
         assert rule.title
         assert rule.__doc__

@@ -1,0 +1,720 @@
+"""Cluster memory & per-job usage ledger tests (ISSUE 14).
+
+Covers the pure fold (`memory_ledger.build_node_report`), the head
+aggregation (byte·s integration, spill/restore rates, the
+`verdict.memory` gates), live-session attribution end to end (seal →
+report → `memory_summary` → `rt_job_*`/`rt_object_owner_*` Prometheus
+series → time-series ring), the leak-suspect path (killed actor owner
+flips `doctor` to exit 1 naming the object), the size-descending
+state-API fix, and — slow-marked — the 2-node `ray_tpu memory --json`
+CLI smoke with the exit-code contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.memory_ledger import (
+    MemoryLedger,
+    build_node_report,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MB = 1024 * 1024
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.for_return(TaskID.from_random(), i)
+
+
+def _entry(
+    i,
+    size,
+    job="job1",
+    owner="driver",
+    owner_pid=1,
+    created_ts=100.0,
+    pinned=True,
+    spilled=False,
+    in_shm=True,
+):
+    return (
+        _oid(i), size, job, owner, owner_pid, created_ts, pinned,
+        spilled, in_shm,
+    )
+
+
+# ---------------------------------------------------------------------------
+# pure fold
+# ---------------------------------------------------------------------------
+
+
+class TestBuildNodeReport:
+    def test_owner_attribution_and_topk(self):
+        entries = [
+            _entry(1, 40, job="a", owner="driver"),
+            _entry(2, 30, job="a", owner="task:t1", pinned=False),
+            _entry(3, 20, job="b", owner="actor:x1"),
+            _entry(4, 10, job="", owner=""),  # unattributed
+        ]
+        report = build_node_report(
+            "node1",
+            entries,
+            {"used": 110, "capacity": 200, "num_objects": 4},
+            {"spilled_bytes": 0, "spilled_objects": 0},
+            topk=2,
+            now=200.0,
+            pid_alive=lambda pid: True,
+        )
+        assert report["attributed_bytes"] == 90
+        assert report["attribution_fraction"] == pytest.approx(
+            90 / 110, abs=1e-3
+        )
+        owners = report["owners"]
+        assert owners["a|driver"]["bytes"] == 40
+        assert owners["a|driver"]["pinned_objects"] == 1
+        assert owners["a|task:t1"]["bytes"] == 30
+        assert owners["b|actor:x1"]["bytes"] == 20
+        # Top-K is size-descending and bounded.
+        top = report["top_objects"]
+        assert [r["size"] for r in top] == [40, 30]
+        assert top[0]["age_s"] == pytest.approx(100.0)
+
+    def test_dead_owner_candidates(self):
+        entries = [
+            _entry(1, 50, owner="actor:a1", owner_pid=111),
+            _entry(2, 40, owner="task:t1", owner_pid=222),
+        ]
+        report = build_node_report(
+            "node1",
+            entries,
+            {"used": 90, "capacity": 100},
+            topk=5,
+            now=200.0,
+            pid_alive=lambda pid: pid != 111,
+        )
+        dead = report["dead_owner_objects"]
+        assert len(dead) == 1
+        assert dead[0]["owner"] == "actor:a1"
+        assert dead[0]["owner_alive"] is False
+        # The same object in top_objects carries the liveness flag.
+        flags = {
+            r["owner"]: r["owner_alive"] for r in report["top_objects"]
+        }
+        assert flags == {"actor:a1": False, "task:t1": True}
+
+    def test_spilled_objects_attributed_without_shm_bytes(self):
+        entries = [
+            _entry(1, 60, spilled=True, in_shm=False),
+            _entry(2, 40),
+        ]
+        report = build_node_report(
+            "node1",
+            entries,
+            {"used": 40, "capacity": 100},
+            {"spilled_bytes": 60, "spilled_objects": 1},
+            now=200.0,
+            pid_alive=lambda pid: True,
+        )
+        row = report["owners"]["job1|driver"]
+        assert row["bytes"] == 40  # arena bytes only
+        assert row["spilled_bytes"] == 60
+        assert report["spilled_objects"] == 1
+
+
+def test_report_fold_overhead_invisible_at_10k_objects():
+    """The per-tick fold at 10k live objects must cost <1% of the
+    default report interval (the PR 5 flight-recorder bar) so the
+    report loop can never surface in bench step medians — the
+    committed `memory_report_ms` microbench tracks the same fold."""
+    from ray_tpu._private.config import Config
+
+    task = TaskID.from_random()
+    entries = [
+        (
+            ObjectID.for_return(task, i + 1),
+            (i % 64 + 1) * 4096,
+            f"{i % 8:08x}",
+            f"task:{i % 200:040x}",
+            0,
+            100.0,
+            i % 3 == 0,
+            i % 17 == 0,
+            True,
+        )
+        for i in range(10_000)
+    ]
+    size_info = {"used": 1 << 30, "capacity": 1 << 34}
+    best_ms = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        build_node_report(
+            "n", entries, size_info, topk=20, now=200.0,
+            pid_alive=lambda pid: True,
+        )
+        best_ms = min(best_ms, (time.perf_counter() - t0) * 1e3)
+    budget_ms = 0.01 * Config().memory_report_interval_s * 1000.0
+    assert best_ms < budget_ms, (
+        f"fold {best_ms:.1f} ms exceeds 1% of the "
+        f"{Config().memory_report_interval_s:g}s report interval"
+    )
+
+
+# ---------------------------------------------------------------------------
+# head ledger
+# ---------------------------------------------------------------------------
+
+
+def _report(node, t, job_bytes, spill_ops=0, restore_ops=0, **kw):
+    owners = {
+        f"{job}|driver": {
+            "job": job,
+            "owner": "driver",
+            "bytes": size,
+            "objects": 1,
+            "pinned_objects": 1,
+            "spilled_bytes": 0,
+        }
+        for job, size in job_bytes.items()
+    }
+    used = sum(job_bytes.values())
+    report = {
+        "node": node,
+        "time": t,
+        "arena_used": used,
+        "arena_capacity": kw.get("capacity", 1000),
+        "tracked_objects": len(job_bytes),
+        "spilled_bytes": 0,
+        "spilled_objects": 0,
+        "spill_ops_total": spill_ops,
+        "restore_ops_total": restore_ops,
+        "owners": owners,
+        "attributed_bytes": used,
+        "attribution_fraction": 1.0,
+        "top_objects": kw.get("top_objects", []),
+        "dead_owner_objects": kw.get("dead_owner_objects", []),
+    }
+    return report
+
+
+class TestMemoryLedger:
+    def test_byte_seconds_integrate_over_report_intervals(self):
+        ledger = MemoryLedger()
+        ledger.fold(_report("n1", 100.0, {"a": 50}))
+        ledger.fold(_report("n1", 110.0, {"a": 50}))
+        jobs = ledger.jobs()
+        assert jobs["a"]["object_bytes"] == 50
+        assert jobs["a"]["object_byte_seconds"] == pytest.approx(500.0)
+        # Second interval with half the bytes integrates half as fast.
+        ledger.fold(_report("n1", 120.0, {"a": 25}))
+        assert ledger.jobs()["a"]["object_byte_seconds"] == pytest.approx(
+            1000.0
+        )
+
+    def test_chip_seconds_from_step_records(self):
+        ledger = MemoryLedger()
+        # Accumulated once per record at APPEND time (daemon
+        # _apply_metric_record) — warmup records are setup wall, not
+        # chip work, and never bill.
+        for record in (
+            {"time": 1.0, "job": "a", "step_ms": 500.0},
+            {"time": 2.0, "job": "a", "step_ms": 500.0},
+            {"time": 2.0, "job": "b", "step_ms": 250.0},
+            {"time": 2.5, "job": "a", "warmup": True, "step_ms": 99.0},
+            {"time": 3.0, "job": "", "step_ms": 99.0},
+        ):
+            ledger.add_step(record)
+        jobs = ledger.jobs()
+        assert jobs["a"]["chip_seconds"] == pytest.approx(1.0)
+        assert jobs["b"]["chip_seconds"] == pytest.approx(0.25)
+        assert "" not in jobs
+
+    def test_accumulator_eviction_never_starves_new_job(self):
+        """A full accumulator table evicts the SMALLEST other row, not
+        the key just bumped — otherwise every new job past the bound
+        would have its first (smallest) row popped on insert and never
+        accumulate anything."""
+        from ray_tpu._private import memory_ledger as ml
+
+        ledger = MemoryLedger()
+        for i in range(ml._MAX_JOBS):
+            ledger.add_step({"job": f"j{i}", "step_ms": 1000.0 * (i + 2)})
+        # Table is full; the newest job is also the smallest row.
+        ledger.add_step({"job": "late", "step_ms": 1000.0})
+        jobs = ledger.jobs()
+        assert jobs["late"]["chip_seconds"] == pytest.approx(1.0)
+        # The smallest pre-existing row (j0) was the victim instead.
+        assert "j0" not in jobs
+
+    def test_metric_entries_shape(self):
+        ledger = MemoryLedger()
+        ledger.fold(_report("n1", 100.0, {"a": 50, "b": 30}))
+        ledger.fold(_report("n1", 101.0, {"a": 50, "b": 30}))
+        entries = ledger.metric_entries()
+        assert entries["rt_job_object_bytes"]["by_tags"]["job=a"] == {
+            "value": 50
+        }
+        assert (
+            entries["rt_job_object_byte_seconds_total"]["kind"]
+            == "counter"
+        )
+        owner_tags = entries["rt_object_owner_bytes"]["by_tags"]
+        assert owner_tags["job=a|owner=driver"] == {"value": 50}
+
+    def test_owner_metric_labels_collapse_to_kind(self):
+        """The exported owner label is the owning-context KIND, never
+        a per-entity id: two task owners in one job must merge into
+        one bounded `owner=task` series (per-id labels are the RT010
+        bug class — even a top-K cut churns the Prometheus label set
+        over the cluster's lifetime)."""
+        ledger = MemoryLedger()
+        report = _report("n1", 100.0, {})
+        report["owners"] = {
+            "a|task:" + "1" * 40: {
+                "job": "a", "owner": "task:" + "1" * 40,
+                "bytes": 30, "objects": 1, "pinned_objects": 0,
+                "spilled_bytes": 0,
+            },
+            "a|task:" + "2" * 40: {
+                "job": "a", "owner": "task:" + "2" * 40,
+                "bytes": 20, "objects": 1, "pinned_objects": 0,
+                "spilled_bytes": 0,
+            },
+            "a|actor:" + "3" * 40: {
+                "job": "a", "owner": "actor:" + "3" * 40,
+                "bytes": 10, "objects": 1, "pinned_objects": 0,
+                "spilled_bytes": 0,
+            },
+        }
+        report["arena_used"] = report["attributed_bytes"] = 60
+        ledger.fold(report)
+        owner_tags = ledger.metric_entries()["rt_object_owner_bytes"][
+            "by_tags"
+        ]
+        assert owner_tags == {
+            "job=a|owner=task": {"value": 50},
+            "job=a|owner=actor": {"value": 10},
+        }
+        # The full per-owner map stays id-resolved for /api/memory.
+        assert {r["owner"] for r in ledger.owners()} == {
+            "task:" + "1" * 40,
+            "task:" + "2" * 40,
+            "actor:" + "3" * 40,
+        }
+
+    def test_verdict_near_capacity_and_thrash(self):
+        ledger = MemoryLedger()
+        ledger.fold(
+            _report("n1", 100.0, {"a": 950}, capacity=1000, spill_ops=0)
+        )
+        ledger.fold(
+            _report(
+                "n1",
+                105.0,
+                {"a": 950},
+                capacity=1000,
+                spill_ops=10,
+                restore_ops=8,
+            )
+        )
+        verdict = ledger.verdict(leak_age_s=300.0, now=105.0)
+        assert len(verdict["near_capacity"]) == 1
+        assert verdict["near_capacity"][0]["node"] == "n1"
+        assert len(verdict["spill_thrash"]) == 1
+        assert "restore rate" in verdict["spill_thrash"][0]["detail"]
+        # Cold-data spilling (few restores) is NOT thrash.
+        ledger.fold(
+            _report(
+                "n1", 110.0, {"a": 100}, spill_ops=20, restore_ops=9
+            )
+        )
+        verdict = ledger.verdict(leak_age_s=300.0, now=110.0)
+        assert verdict["spill_thrash"] == []
+        assert verdict["near_capacity"] == []
+
+    def test_verdict_leak_gates_on_age_and_owner_death(self):
+        dead_row = {
+            "object_id": "ab" * 20,
+            "size": 100,
+            "job": "a",
+            "owner": "actor:x",
+            "owner_alive": False,
+            "age_s": 400.0,
+            "pinned": True,
+        }
+        young = dict(dead_row, object_id="cd" * 20, age_s=5.0)
+        ledger = MemoryLedger()
+        ledger.fold(
+            _report(
+                "n1",
+                100.0,
+                {"a": 100},
+                dead_owner_objects=[dead_row, young],
+            )
+        )
+        verdict = ledger.verdict(leak_age_s=300.0)
+        assert [s["object_id"] for s in verdict["leak_suspects"]] == [
+            "ab" * 20
+        ]
+        # A looser deadline convicts the young one too; a stricter
+        # one convicts neither.
+        assert len(ledger.verdict(leak_age_s=1.0)["leak_suspects"]) == 2
+        assert ledger.verdict(leak_age_s=500.0)["leak_suspects"] == []
+
+    def test_verdict_leak_on_ended_job(self):
+        row = {
+            "object_id": "ef" * 20,
+            "size": 100,
+            "job": "gone",
+            "owner": "driver",
+            "owner_alive": True,
+            "age_s": 400.0,
+            "pinned": True,
+        }
+        ledger = MemoryLedger()
+        ledger.fold(_report("n1", 100.0, {"gone": 100}, top_objects=[row]))
+        verdict = ledger.verdict(
+            leak_age_s=300.0, job_ended=lambda job: job == "gone"
+        )
+        assert len(verdict["leak_suspects"]) == 1
+        assert "job already ended" in verdict["leak_suspects"][0]["detail"]
+        assert ledger.verdict(leak_age_s=300.0)["leak_suspects"] == []
+
+    def test_dead_node_report_dropped(self):
+        ledger = MemoryLedger()
+        ledger.fold(_report("n1", 100.0, {"a": 50}))
+        ledger.fold(_report("n2", 100.0, {"a": 30}))
+        assert ledger.jobs()["a"]["object_bytes"] == 80
+        ledger.drop_node("n2")
+        assert ledger.jobs()["a"]["object_bytes"] == 50
+
+
+# ---------------------------------------------------------------------------
+# live session: attribution, series, leak doctor, state API
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ledger_session():
+    import ray_tpu as rt
+
+    rt.init(
+        num_cpus=2,
+        _system_config={
+            "memory_report_interval_s": 0.2,
+            "metrics_timeseries_interval_s": 0.3,
+        },
+    )
+    yield rt
+    rt.shutdown()
+
+
+def test_put_bytes_attributed_to_job_and_exported(ledger_session):
+    """Acceptance core: ≥95% of reported arena-used bytes attribute
+    to a (job, owner) pair, and the ledger's `rt_job_*` /
+    `rt_object_owner_*` series render on the Prometheus surface and
+    land in consecutive time-series snapshots."""
+    rt = ledger_session
+    refs = [
+        rt.put(np.ones(500_000, dtype=np.float64)) for _ in range(3)
+    ]
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util.state import memory_summary
+
+    job_hex = global_worker().job_id.hex()
+    mem = memory_summary()
+    totals = mem["totals"]
+    assert totals["arena_used"] > 0
+    assert totals["attribution_fraction"] >= 0.95, totals
+    assert mem["jobs"][job_hex]["object_bytes"] >= 3 * 4_000_000
+    assert mem["jobs"][job_hex]["pinned_objects"] == 3
+    owner_rows = [
+        r for r in mem["owners"] if r["job"] == job_hex
+    ]
+    assert owner_rows and owner_rows[0]["owner"] == "driver"
+    # Prometheus exposition carries the per-job and per-owner series.
+    from ray_tpu.util.metrics import metrics_summary
+    from ray_tpu.util.prometheus import render_prometheus
+
+    text = render_prometheus(metrics_summary())
+    assert f'rt_job_object_bytes{{job="{job_hex}"}}' in text
+    assert "rt_object_owner_bytes{" in text
+    # Two consecutive snapshot-ring entries carry the series (the
+    # trend survives the live window).
+    from ray_tpu.util.metrics import metrics_timeseries
+
+    deadline = time.time() + 15
+    snaps = []
+    while time.time() < deadline and len(snaps) < 2:
+        snaps = metrics_timeseries(name="rt_job_object_bytes")
+        time.sleep(0.2)
+    assert len(snaps) >= 2, "series never reached 2 snapshots"
+    for snap in snaps[-2:]:
+        by_tags = snap["metrics"]["rt_job_object_bytes"]["by_tags"]
+        assert by_tags[f"job={job_hex}"]["value"] > 0
+    # Step telemetry feeds the per-job chip·s counter: after a few
+    # reported steps the series appears in consecutive snapshots too.
+    from ray_tpu.train import telemetry
+    from ray_tpu.util import metrics as um
+
+    for step in range(1, 4):
+        telemetry.report_step(
+            step, rank=0, step_ms=100.0, wall_ms=110.0
+        )
+    um.flush()
+    deadline = time.time() + 15
+    chip_snaps = []
+    while time.time() < deadline and len(chip_snaps) < 2:
+        chip_snaps = metrics_timeseries(
+            name="rt_job_chip_seconds_total"
+        )
+        time.sleep(0.2)
+    assert len(chip_snaps) >= 2, "chip·s series never snapshotted"
+    latest = chip_snaps[-1]["metrics"]["rt_job_chip_seconds_total"]
+    assert latest["by_tags"][f"job={job_hex}"]["total"] == pytest.approx(
+        0.3
+    )
+    del refs
+
+
+def test_interval_zero_is_a_real_kill_switch():
+    """`memory_report_interval_s=0` stands the ledger down WHOLE:
+    no on-demand head folds, no rt_job_* series, and the summary says
+    `disabled` — a head-only fold would dress a half-blind ledger up
+    as cluster truth (worker nodes aren't reporting)."""
+    import ray_tpu as rt
+
+    rt.init(
+        num_cpus=1, _system_config={"memory_report_interval_s": 0}
+    )
+    try:
+        _ = rt.put(np.ones(500_000, dtype=np.float64))
+        from ray_tpu.train import telemetry
+        from ray_tpu.util import metrics as um
+        from ray_tpu.util.state import memory_summary
+
+        telemetry.report_step(1, rank=0, step_ms=100.0, wall_ms=110.0)
+        um.flush()
+        mem = memory_summary()
+        assert mem.get("disabled") is True
+        assert mem["jobs"] == {}
+        assert mem["totals"]["arena_used"] == 0
+        ms = um.metrics_summary()
+        assert "rt_job_object_bytes" not in ms
+        assert "rt_job_chip_seconds_total" not in ms
+    finally:
+        rt.shutdown()
+
+
+def test_actor_put_attributed_to_actor_owner(ledger_session):
+    rt = ledger_session
+
+    @rt.remote
+    class Producer:
+        def make(self):
+            self.ref = rt.put(np.ones(500_000, dtype=np.float64))
+            return self.ref
+
+    producer = Producer.remote()
+    ref = rt.get(producer.make.remote(), timeout=60)
+    from ray_tpu.util.state import memory_summary
+
+    deadline = time.time() + 15
+    actor_rows = []
+    while time.time() < deadline and not actor_rows:
+        actor_rows = [
+            r
+            for r in memory_summary()["owners"]
+            if r["owner"].startswith("actor:")
+        ]
+        time.sleep(0.2)
+    assert actor_rows, "actor-owned bytes never attributed"
+    assert actor_rows[0]["bytes"] >= 4_000_000
+    del ref
+
+
+def test_killed_actor_owner_becomes_leak_suspect(ledger_session):
+    """The CI leak scenario: an actor creates and holds a large
+    object, the actor's worker is killed, the object stays held
+    (driver ref + primary pin) — doctor names it under
+    `verdict.memory` once it outlives the leak deadline, and the
+    healthy 300s default stays quiet."""
+    rt = ledger_session
+
+    @rt.remote
+    class Holder:
+        def hold(self):
+            self.ref = rt.put(np.ones(500_000, dtype=np.float64))
+            return self.ref
+
+    holder = Holder.remote()
+    ref = rt.get(holder.hold.remote(), timeout=60)
+    rt.kill(holder, no_restart=True)
+    deadline = time.time() + 30
+    leaks = []
+    while time.time() < deadline and not leaks:
+        time.sleep(0.4)
+        verdict = rt.diagnose(capture_stacks=False, leak_age_s=0.5)
+        leaks = [
+            p
+            for p in verdict["problems"]
+            if p["kind"] == "object_leak"
+        ]
+    assert leaks, "killed pinning owner never flagged"
+    assert leaks[0]["object_id"] == ref.hex()
+    assert leaks[0]["owner"].startswith("actor:")
+    assert verdict["memory"]["leak_suspects"]
+    # Default deadline (300s): same cluster is healthy.
+    assert rt.diagnose(capture_stacks=False)["healthy"] is True
+
+
+def test_list_objects_size_descending_with_ledger_columns(
+    ledger_session,
+):
+    rt = ledger_session
+    small = rt.put(np.ones(200_000, dtype=np.float64))  # 1.6 MB
+    big = rt.put(np.ones(800_000, dtype=np.float64))  # 6.4 MB
+    from ray_tpu.util.state import list_objects
+
+    rows = list_objects()
+    sizes = [r["size"] for r in rows]
+    assert sizes == sorted(sizes, reverse=True)
+    top = rows[0]
+    assert top["object_id"] == big.hex()
+    # The ledger columns ride every row.
+    for column in ("job", "owner", "age_s", "spilled", "pinned"):
+        assert column in top, top
+    assert top["owner"] == "driver"
+    assert top["pinned"] is True
+    # `limit` keeps the LARGEST rows, not an arbitrary dict slice.
+    assert list_objects(limit=1)[0]["object_id"] == big.hex()
+    del small, big
+
+
+# ---------------------------------------------------------------------------
+# CI smoke: 2-node cluster, CLI surfaces, exit-code contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_memory_cli_smoke_two_nodes(tmp_path):
+    """Satellite CI smoke: a 2-node cluster where one job holds
+    pinned objects. `ray_tpu memory --json` (a separate process, as
+    an operator runs it) attributes ≥95% of arena-used bytes to the
+    job and exits 0; the Prometheus scrape renders `rt_job_*` /
+    `rt_object_owner_*`; a synthetic leak (killed pinning worker)
+    flips `doctor --json` to exit 1 naming the object."""
+    from ray_tpu.cluster_utils import Cluster
+
+    import ray_tpu as rt
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"CPU": 2.0},
+        system_config={"memory_report_interval_s": 0.2},
+    )
+    c.add_node(num_cpus=2, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        job_hex = global_worker().job_id.hex()
+
+        @rt.remote(resources={"remote_node": 1.0})
+        def produce():
+            return np.ones(500_000, dtype=np.float64)
+
+        local_refs = [
+            rt.put(np.ones(500_000, dtype=np.float64))
+            for _ in range(2)
+        ]
+        remote_ref = produce.remote()
+        _ = rt.get(remote_ref, timeout=90)
+        time.sleep(1.0)  # ≥1 report tick from both nodes
+
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "ray_tpu", "memory", "--json",
+                "--address", c.address,
+            ],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        mem = json.loads(out.stdout)
+        assert mem["totals"]["attribution_fraction"] >= 0.95, mem[
+            "totals"
+        ]
+        assert mem["jobs"][job_hex]["object_bytes"] >= 8_000_000
+        assert len(mem["nodes"]) == 2
+        # The producing task's bytes attribute to a task owner on
+        # the remote node.
+        assert any(
+            r["owner"].startswith("task:")
+            for r in mem["owners"]
+            if r["job"] == job_hex
+        ), mem["owners"]
+
+        scrape = subprocess.run(
+            [
+                sys.executable, "-m", "ray_tpu", "metrics", "scrape",
+                "--address", c.address,
+            ],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert scrape.returncode == 0, scrape.stdout + scrape.stderr
+        assert f'rt_job_object_bytes{{job="{job_hex}"}}' in scrape.stdout
+        assert "rt_object_owner_bytes{" in scrape.stdout
+
+        # Synthetic leak: kill the actor worker holding an object.
+        @rt.remote
+        class Holder:
+            def hold(self):
+                self.ref = rt.put(
+                    np.ones(500_000, dtype=np.float64)
+                )
+                return self.ref
+
+        holder = Holder.remote()
+        leak_ref = rt.get(holder.hold.remote(), timeout=60)
+        rt.kill(holder, no_restart=True)
+        deadline = time.time() + 60
+        doctor = None
+        while time.time() < deadline:
+            time.sleep(1.0)
+            doctor = subprocess.run(
+                [
+                    sys.executable, "-m", "ray_tpu", "doctor",
+                    "--json", "--address", c.address,
+                    "--leak-age-s", "0.5", "--no-stacks",
+                ],
+                env=env, capture_output=True, text=True, timeout=120,
+            )
+            if doctor.returncode == 1:
+                verdict = json.loads(doctor.stdout)
+                leaks = [
+                    p
+                    for p in verdict["problems"]
+                    if p["kind"] == "object_leak"
+                ]
+                if leaks:
+                    break
+        assert doctor is not None and doctor.returncode == 1, (
+            doctor.stdout + doctor.stderr if doctor else "no run"
+        )
+        assert [p["object_id"] for p in leaks] == [leak_ref.hex()]
+        assert verdict["memory"]["leak_suspects"]
+        del local_refs, remote_ref
+    finally:
+        rt.shutdown()
+        c.shutdown()
