@@ -19,6 +19,7 @@ from .api import (
     is_initialized,
     kill,
     nodes,
+    profile_gang,
     put,
     remote,
     shutdown,
@@ -51,6 +52,7 @@ __all__ = [
     "timeline",
     "state_summary",
     "diagnose",
+    "profile_gang",
     "ObjectRef",
     "ObjectRefGenerator",
     "ActorClass",

@@ -195,6 +195,25 @@ class Config:
     #: seconds after its creation whose owner process died (or whose
     #: job ended) is named a leak suspect.
     doctor_leak_age_s: float = 300.0
+    #: XLA compile watcher (_private/compile_watch.py): per-process
+    #: listener recording every compilation of a registered jitted
+    #: program as (name, shape digest, duration) — compile counters
+    #: on /metrics, compile_ms as a step stall phase, recompile-storm
+    #: detection in `doctor`. Env RT_compile_watch_enabled=0 is the
+    #: per-process kill switch (flight-recorder contract).
+    compile_watch_enabled: bool = True
+    #: Distinct shape digests of ONE program past which the doctor
+    #: calls a recompile storm (`verdict.compile`). Set above any
+    #: legitimate bucket family (prefill length buckets, policy batch
+    #: buckets top out at ~6) so healthy bucketed programs never trip
+    #: it while a drifting shape — one new digest per iteration —
+    #: crosses it within seconds.
+    compile_storm_threshold: int = 8
+    #: Cap on one coordinated gang-profile window
+    #: (`rt.profile_gang` / `ray_tpu profile --job`): every rank
+    #: samples for the whole window and the head holds one RPC pool
+    #: thread per rank for it.
+    profile_gang_max_duration_s: float = 60.0
     #: Kill switch for the continuous-batching LLM serving engine
     #: (ray_tpu/llm): RT_serve_engine_enabled=0 makes `build_llm_app`
     #: deployments fall back to per-request `generate_stream()` — the

@@ -385,6 +385,13 @@ class NodeDaemon:
         self._step_records: deque = deque(
             maxlen=config.task_events_max_buffer
         )
+        # XLA compile watch (head): per-program digest rings folded
+        # from kind="compile" metrics-pipe records
+        # (_private/compile_watch.py fold_record — the same structure
+        # the per-process registry keeps, so detect_storms serves
+        # both). Bounded by construction: program names are
+        # registered families, digests ring-capped per program.
+        self._compile_programs: Dict[str, dict] = {}
         # Head time-series ring: periodic compacted snapshots of the
         # metric table so p50/p99 TRENDS survive past the live
         # reservoir (`/api/timeseries`, `ray_tpu metrics snapshot`).
@@ -404,9 +411,11 @@ class NodeDaemon:
         self._memory_folded_at = 0.0
         # This process's flight recorder obeys the cluster config
         # (env RT_flight_recorder_enabled already applied at import).
+        from .compile_watch import configure as _compile_configure
         from .flight_recorder import configure as _flight_configure
 
         _flight_configure(config)
+        _compile_configure(config)
 
         max_workers = config.max_workers_per_node or max(
             4, int(4 * resources.get("CPU", 1))
@@ -511,6 +520,10 @@ class NodeDaemon:
             "memory_summary",
             "event_stats",
             "profile_worker",
+            # XLA observability: coordinated gang profiling + the
+            # head's folded compile table (verdict.compile's data)
+            "profile_gang",
+            "compile_summary",
             # flight recorder / stall doctor (all nodes; diagnose and
             # step_summary forward to the head)
             "flight_recorder",
@@ -4322,6 +4335,29 @@ class NodeDaemon:
         finally:
             client.close()
 
+    #: Forwardable profile parameters (shared by the single-worker
+    #: relay, the doctor's stack capture, and the gang fan-out —
+    #: `start_at` is the gang window's synchronized start).
+    _PROFILE_PARAMS = ("kind", "duration_s", "hz", "top", "start_at")
+
+    def _profile_target(
+        self, node_id, pid: int, timeout: float, **params
+    ) -> dict:
+        """ONE start/stop/collect implementation for every profile
+        capture: route to the owning daemon (driver -> head -> node)
+        when `node_id` is remote, else call the local worker's direct
+        `profile` endpoint. The single-worker RPC, the doctor's
+        hung-task stack capture, and the gang-profile fan-out all run
+        through here — no per-caller capture paths to drift."""
+        reply = self._relay_to_node(
+            "profile_worker", node_id, timeout, pid=pid, **params
+        )
+        if reply is not None:
+            return reply
+        return self._call_worker_direct(
+            pid, "profile", timeout, **params
+        )
+
     def _h_profile_worker(self, conn, msg):
         """Attach an on-demand profiler to a live worker (reference:
         dashboard reporter profile_manager.py py-spy/memray attach;
@@ -4330,28 +4366,183 @@ class NodeDaemon:
         Routing: pid alone targets this node; (node_id, pid) routes
         driver -> head -> owning daemon. Blocks one RPC pool thread
         for the profile window (rare, operator-driven)."""
-        fwd = {
-            k: msg[k]
-            for k in ("pid", "kind", "duration_s", "hz", "top")
-            if k in msg
+        params = {
+            k: msg[k] for k in self._PROFILE_PARAMS if k in msg
         }
+        params.setdefault("kind", "stack")
         timeout = float(msg.get("duration_s", 5.0)) + 30.0
-        reply = self._relay_to_node(
-            "profile_worker", msg.get("node_id"), timeout, **fwd
+        if "start_at" in params:
+            timeout += max(0.0, float(params["start_at"]) - time.time())
+        return self._profile_target(
+            msg.get("node_id"), msg["pid"], timeout, **params
         )
-        if reply is not None:
-            return reply
-        return self._call_worker_direct(
-            msg["pid"],
-            "profile",
-            timeout,
-            kind=msg.get("kind", "stack"),
-            **{
+
+    def _h_profile_gang(self, conn, msg):
+        """Coordinated gang profiling (`rt.profile_gang` /
+        `ray_tpu profile --job`): fan ONE synchronized start/stop
+        window out to every rank of a gang through the profile relay,
+        and merge the per-rank capture artifacts with the gang's
+        step-telemetry phases into one chrome trace on a shared
+        (unix-epoch-us) clock. Head-only: the step ring that knows
+        which (node, pid) hosts each rank lives here."""
+        if not self.is_head:
+            fwd = {
                 k: msg[k]
-                for k in ("duration_s", "hz", "top")
+                for k in ("job", "duration_s", "hz")
                 if k in msg
-            },
+            }
+            # Forward timeout tracks the requested window (the head
+            # legitimately blocks for duration + fan-out slack) — a
+            # fixed value would throw away a long capture that ran
+            # to completion.
+            return self.head.call(
+                "profile_gang",
+                timeout=float(msg.get("duration_s", 2.0)) + 120.0,
+                **fwd,
+            )
+        duration_s = min(
+            float(msg.get("duration_s", 2.0)),
+            self.config.profile_gang_max_duration_s,
         )
+        hz = float(msg.get("hz", 100.0))
+        job = msg.get("job") or None
+        with self._lock:
+            step_records = list(self._step_records)
+        if job is None:
+            # Default to the most recently reporting job — the one an
+            # operator watching a slow gang means.
+            latest: Dict[str, float] = {}
+            for rec in step_records:
+                j = str(rec.get("job", ""))
+                latest[j] = max(
+                    latest.get(j, 0.0), float(rec.get("time", 0.0))
+                )
+            job = max(latest, key=lambda j: latest[j], default=None)
+        job_records = [
+            r for r in step_records if str(r.get("job", "")) == job
+        ]
+        # Gang members = the reporting processes of the job's recent
+        # step records; rank identity rides every record already.
+        members: Dict[tuple, int] = {}
+        for rec in job_records:
+            node, pid = rec.get("node"), rec.get("pid")
+            if node and pid:
+                members[(str(node), int(pid))] = int(
+                    rec.get("rank", 0)
+                )
+        if not members:
+            raise ValueError(
+                f"no step-reporting ranks found for job {job!r} — "
+                "gang profiling needs a gang that reports step "
+                "telemetry"
+            )
+        # Synchronized window: every rank sleeps until start_at, then
+        # samples for the same duration — slices across ranks line up
+        # on the shared clock instead of staggering by fan-out order.
+        start_at = time.time() + 0.5
+        timeout = duration_s + 30.0 + (start_at - time.time())
+
+        def capture(item):
+            (node_hex, pid), rank = item
+            try:
+                reply = self._profile_target(
+                    bytes.fromhex(node_hex),
+                    pid,
+                    timeout,
+                    kind="gang",
+                    duration_s=duration_s,
+                    hz=hz,
+                    start_at=start_at,
+                )
+                return rank, reply, None
+            except Exception as e:  # noqa: BLE001 — per-rank finding
+                return rank, None, repr(e)
+
+        trace: list = []
+        ranks: list = []
+        errors: Dict[int, str] = {}
+        # Dedicated pool sized to the gang: _parallel_map's shared
+        # 8-thread cap would serialize ranks 9+ past start_at —
+        # every rank must hold an in-flight RPC for the WHOLE window
+        # or the "synchronized" slices silently stagger.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(64, len(members))
+        ) as pool:
+            captures = list(
+                pool.map(
+                    capture,
+                    sorted(
+                        members.items(), key=lambda kv: kv[1]
+                    ),
+                )
+            )
+        for rank, reply, err in captures:
+            if err is not None:
+                errors[rank] = err
+                continue
+            row = {
+                "rank": rank,
+                "samples": reply.get("samples", 0),
+                "threads": reply.get("threads", 0),
+            }
+            if reply.get("jax_trace_dir"):
+                row["jax_trace_dir"] = reply["jax_trace_dir"]
+            ranks.append(row)
+            for event in reply.get("events", ()):
+                # Re-home each rank's slices under one rank-labeled
+                # process row so the merged view reads like the gang.
+                event = dict(event)
+                event["pid"] = f"rank {rank}"
+                event.setdefault("args", {})["rank"] = rank
+                trace.append(event)
+        # Step-telemetry phases of the same job on the same clock —
+        # the markers that say WHICH step the hot stacks sat in.
+        from .step_telemetry import steps_to_chrome_trace
+
+        window_records = [
+            r
+            for r in job_records
+            if float(r.get("time", 0.0)) >= start_at - 60.0
+        ]
+        trace.extend(steps_to_chrome_trace(window_records))
+        return {
+            "job": job,
+            "trace": trace,
+            "ranks": ranks,
+            "errors": errors,
+            "window": {
+                "start": start_at,
+                "duration_s": duration_s,
+            },
+        }
+
+    def _h_compile_summary(self, conn, msg):
+        """The head's folded compile table + current storm verdict
+        (`/api/compile`; the cluster half of
+        compile_watch.snapshot())."""
+        if not self.is_head:
+            return self.head.call("compile_summary")
+        from .compile_watch import detect_storms
+
+        with self._lock:
+            programs = {
+                name: {
+                    "compiles": row["compiles"],
+                    "total_ms": round(row["total_ms"], 3),
+                    "distinct_shapes": len(row["digests"]),
+                    "digests": {
+                        k: dict(v) for k, v in row["digests"].items()
+                    },
+                }
+                for name, row in self._compile_programs.items()
+            }
+            storms = detect_storms(
+                self._compile_programs,
+                self.config.compile_storm_threshold,
+            )
+        return {"compile": {"programs": programs, "storms": storms}}
 
     def _h_list_task_events(self, conn, msg):
         if not self.is_head:
@@ -4652,6 +4843,21 @@ class NodeDaemon:
             if self.config.memory_report_interval_s > 0:
                 self._memory_ledger.add_step(record)
             return
+        if kind == "compile":
+            # XLA compile events ride the pipe like step records:
+            # `name` is the program, `value` the compile duration,
+            # `tags` the digest/shape payload. Folded into the
+            # per-program digest ring the storm detector reads;
+            # count/duration AGGREGATES arrive separately as the
+            # rt_jax_* counter/histogram records.
+            from .compile_watch import fold_record
+
+            info = {str(k): v for k, v in tags}
+            info["time"] = time.time()
+            fold_record(
+                self._compile_programs, str(name), float(value), info
+            )
+            return
         declared = tuple(rec[4]) if len(rec) > 4 else ()
         tags = tuple(tuple(t) for t in tags)
         entry = self._metrics_table.setdefault(
@@ -4913,6 +5119,8 @@ class NodeDaemon:
     def _h_metrics_summary(self, conn, msg):
         if not self.is_head:
             return self.head.call("metrics_summary")
+        from .metric_defs import PIPE_METRICS
+
         with self._lock:
             out = {}
             for name, entry in self._metrics_table.items():
@@ -4933,6 +5141,14 @@ class NodeDaemon:
                     fmt(bucket)
                     for tags, bucket in entry["by_tags"].items()
                 }
+                # Declared pipe metrics carry their metric_defs
+                # description so /metrics renders a HELP line.
+                declared_meta = PIPE_METRICS.get(name)
+                if declared_meta is not None:
+                    clean.setdefault("unit", declared_meta[1])
+                    clean.setdefault(
+                        "description", declared_meta[2]
+                    )
                 out[name] = clean
         # Core runtime metrics (reference: stats/metric_defs.cc):
         # head scrapes itself; worker nodes' latest snapshots rode
@@ -5442,6 +5658,35 @@ class NodeDaemon:
         # Decoupled-RL dataflow: queue levels/gates + weight versions
         # folded into an actor-vs-learner bottleneck attribution.
         rl = self._rl_summary()
+        # XLA layer: recompile storms from the head's per-program
+        # digest rings and HBM pressure from the step records' device
+        # memory fields — promoted to problems so the exit-code
+        # contract covers the compiler too (a storm IS a sick
+        # cluster: every flagged iteration burns seconds of compile).
+        compile_verdict = self._compile_verdict(
+            step_records,
+            threshold=msg.get("compile_storm_threshold"),
+        )
+        for storm in compile_verdict.get("storms", ()):
+            problems.append(
+                {
+                    "kind": "recompile_storm",
+                    "program": storm["program"],
+                    "compiles": storm["compiles"],
+                    "distinct_shapes": storm["distinct_shapes"],
+                    "delta": storm["delta"],
+                    "detail": storm["detail"],
+                }
+            )
+        for row in compile_verdict.get("hbm_pressure", ()):
+            problems.append(
+                {
+                    "kind": "hbm_pressure",
+                    "rank": row["rank"],
+                    "fraction": row["fraction"],
+                    "detail": row["detail"],
+                }
+            )
         # Memory ledger: near-capacity nodes, leak suspects past the
         # leak deadline, spill thrash — each promoted to a problem so
         # the exit-code contract covers memory health too.
@@ -5637,19 +5882,16 @@ class NodeDaemon:
                     to_capture.append((problem, row))
                 problems.append(problem)
         if to_capture:
-            # Auto-capture every offender's stacks through the
-            # existing profile relay — the dump an operator would ask
-            # for next, taken while it still shows the hang.
+            # Auto-capture every offender's stacks through the SAME
+            # profile relay the gang profiler uses (_profile_target —
+            # one start/stop/collect implementation) — the dump an
+            # operator would ask for next, taken while it still shows
+            # the hang.
             def capture_stack(target):
                 problem, row = target
                 try:
-                    reply = self._h_profile_worker(
-                        conn,
-                        {
-                            "pid": row["pid"],
-                            "node_id": row["node_id"],
-                            "kind": "stack",
-                        },
+                    reply = self._profile_target(
+                        row["node_id"], row["pid"], 35.0, kind="stack"
                     )
                     problem["stack"] = reply.get("stacks", "")
                 except Exception as e:  # noqa: BLE001 — verdict survives
@@ -5720,6 +5962,7 @@ class NodeDaemon:
                 "steps": steps,
                 "dag": dag,
                 "rl": rl,
+                "compile": compile_verdict,
                 "memory": memory,
                 "rpc": ring_digests,
                 "nodes": {
@@ -5733,6 +5976,81 @@ class NodeDaemon:
                 },
             }
         }
+
+    def _compile_verdict(
+        self, step_records: list, threshold=None
+    ) -> dict:
+        """`verdict.compile`: per-program compile counts, recompile
+        storms (same program, >= threshold distinct shape digests —
+        the drifting-shape retrace loop), and HBM pressure (latest
+        per-(job, rank) device-memory report >= 90% of capacity).
+        Caller must NOT hold self._lock."""
+        from .compile_watch import detect_storms
+
+        threshold = int(
+            threshold
+            if threshold is not None
+            else self.config.compile_storm_threshold
+        )
+        with self._lock:
+            programs = {
+                name: {
+                    "compiles": row["compiles"],
+                    "total_ms": round(row["total_ms"], 3),
+                    "distinct_shapes": len(row["digests"]),
+                }
+                for name, row in self._compile_programs.items()
+            }
+            storms = detect_storms(self._compile_programs, threshold)
+        out: dict = {
+            "programs": programs,
+            "storms": storms,
+            "storm_threshold": threshold,
+            "hbm_pressure": [],
+        }
+        # HBM pressure: newest RECENT record per (job, rank) that
+        # carries both in-use and limit; absent fields (CPU)
+        # contribute nothing — never synthesized. The recency cutoff
+        # keeps a finished job's final 92%-HBM records (which sit in
+        # the bounded ring until new traffic evicts them) from
+        # flipping an idle cluster's doctor to exit 1 forever.
+        cutoff = time.time() - 120.0
+        latest: Dict[tuple, dict] = {}
+        for rec in step_records:
+            if "hbm_bytes_in_use" not in rec:
+                continue
+            if float(rec.get("time", 0.0)) < cutoff:
+                continue
+            key = (str(rec.get("job", "")), int(rec.get("rank", 0)))
+            if float(rec.get("time", 0.0)) >= float(
+                latest.get(key, {}).get("time", -1.0)
+            ):
+                latest[key] = rec
+        for (job, rank), rec in sorted(latest.items()):
+            limit = int(rec.get("hbm_bytes_limit", 0) or 0)
+            in_use = int(rec.get("hbm_bytes_in_use", 0) or 0)
+            if limit <= 0:
+                continue
+            fraction = in_use / limit
+            if fraction >= 0.9:
+                out["hbm_pressure"].append(
+                    {
+                        "rank": rank,
+                        "job": job,
+                        "bytes_in_use": in_use,
+                        "bytes_limit": limit,
+                        "fraction": round(fraction, 4),
+                        "detail": (
+                            f"rank {rank} HBM at "
+                            f"{100.0 * fraction:.1f}% of capacity "
+                            f"({in_use / 2**30:.2f} / "
+                            f"{limit / 2**30:.2f} GiB) — next "
+                            "allocation or fragmentation spike OOMs "
+                            "this rank"
+                        ),
+                    }
+                )
+        return out
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         if state == "RETRY":

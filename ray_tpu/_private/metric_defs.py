@@ -85,6 +85,38 @@ CORE_METRICS: Dict[str, tuple] = {
     "rt_rss_mb": ("gauge", "MiB", "Daemon resident set size"),
 }
 
+#: Descriptions for metrics that ride the util/metrics._Buffer pipe
+#: (pushed by workers, folded into the head's aggregate table) rather
+#: than being collect()ed off daemon state. Kept here so the whole
+#: namespace is documented in ONE module and `/metrics` renders HELP
+#: lines for them; absence from this table is fine (user metrics),
+#: it just means no HELP line.
+PIPE_METRICS: Dict[str, tuple] = {
+    # -- XLA layer (_private/compile_watch.py) -----------------------
+    "rt_jax_compiles_total": (
+        "counter", "compiles",
+        "XLA compilations recorded per program (label: program name "
+        "only — shape digests stay in the diagnostic ring)",
+    ),
+    "rt_jax_compile_ms": (
+        "histogram", "ms",
+        "Duration of each recorded XLA compilation, per program",
+    ),
+    "rt_hbm_bytes_in_use": (
+        "gauge", "bytes",
+        "Device HBM bytes in use, per reporting rank "
+        "(device.memory_stats(); absent on CPU backends)",
+    ),
+    "rt_hbm_peak_bytes": (
+        "gauge", "bytes",
+        "Peak device HBM bytes in use, per reporting rank",
+    ),
+    "rt_hbm_bytes_limit": (
+        "gauge", "bytes",
+        "Device HBM capacity visible to the reporting rank",
+    ),
+}
+
 
 class CoreCounters:
     """Monotonic event counters; one instance per daemon process.

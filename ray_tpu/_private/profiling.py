@@ -138,9 +138,161 @@ def profile_memory(duration_s: float = 5.0, top: int = 20) -> dict:
     }
 
 
+#: Cap on chrome-trace slices one timeline capture may emit (a 100 Hz
+#: window over a thrashing thread churns slices; the merged gang
+#: artifact must stay loadable).
+_MAX_TIMELINE_EVENTS = 20000
+
+
+def sample_timeline(
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    start_at: Optional[float] = None,
+) -> dict:
+    """Wall-clock TIMELINE sampler: like `sample_cpu`, but instead of
+    folding samples into counts it coalesces consecutive samples of
+    one thread's leaf frame into chrome-trace 'X' slices on the
+    UNIX-EPOCH-us clock — the shared clock every rank of a gang
+    agrees on, which is what makes the merged gang profile line up.
+    `start_at` (unix seconds) synchronizes the window start across
+    ranks: the sampler sleeps until then before its first sample.
+    Returns {"events", "folded", "samples", "threads", "t0", "t1"}.
+    """
+    duration_s = min(float(duration_s), 120.0)
+    interval = 1.0 / max(1.0, min(float(hz), 1000.0))
+    if start_at is not None:
+        delay = float(start_at) - time.time()
+        if delay > 0:
+            time.sleep(min(delay, 30.0))
+    me = threading.get_ident()
+    counts: Counter = Counter()
+    #: thread ident -> [slice_name, start_us, last_seen_us]
+    open_slices: Dict[int, list] = {}
+    events: List[dict] = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+
+    def close(ident: int, now_us: float) -> None:
+        entry = open_slices.pop(ident, None)
+        if entry is None or len(events) >= _MAX_TIMELINE_EVENTS:
+            return
+        name, start_us, _last = entry
+        events.append(
+            {
+                "name": name,
+                "cat": "sample",
+                "ph": "X",
+                "ts": start_us,
+                "dur": max(1.0, now_us - start_us),
+                "pid": "profile",
+                "tid": names.get(ident, f"thread {ident}"),
+            }
+        )
+
+    samples = 0
+    threads_seen: set = set()
+    t0 = time.time()
+    deadline = t0 + duration_s
+    while time.time() < deadline:
+        now_us = time.time() * 1e6
+        frames = sys._current_frames()
+        for ident in list(open_slices):
+            if ident not in frames:
+                close(ident, now_us)
+        for ident, frame in frames.items():
+            if ident == me:
+                continue
+            threads_seen.add(ident)
+            if ident not in names:
+                names[ident] = next(
+                    (
+                        t.name
+                        for t in threading.enumerate()
+                        if t.ident == ident
+                    ),
+                    f"thread {ident}",
+                )
+            code = frame.f_code
+            leaf = (
+                f"{code.co_name} "
+                f"({code.co_filename.rsplit('/', 1)[-1]}"
+                f":{frame.f_lineno})"
+            )
+            counts[_folded(frame)] += 1
+            entry = open_slices.get(ident)
+            if entry is not None and entry[0] == leaf:
+                entry[2] = now_us
+            else:
+                if entry is not None:
+                    close(ident, now_us)
+                open_slices[ident] = [leaf, now_us, now_us]
+        samples += 1
+        time.sleep(interval)
+    end_us = time.time() * 1e6
+    for ident in list(open_slices):
+        close(ident, end_us)
+    return {
+        "events": events,
+        "folded": "\n".join(
+            f"{stack} {n}" for stack, n in counts.most_common()
+        ),
+        "samples": samples,
+        "threads": len(threads_seen),
+        "duration_s": duration_s,
+        "hz": hz,
+        "t0": t0,
+        "t1": end_us / 1e6,
+    }
+
+
+def capture_gang(
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    start_at: Optional[float] = None,
+) -> dict:
+    """One rank's share of a coordinated gang-profile window. On TPU
+    (and other accelerator) backends the window additionally runs
+    under a `jax.profiler` trace whose artifact directory rides back
+    in the result; everywhere else — and alongside it — the
+    in-process timeline sampler provides the chrome-trace slices the
+    head merges. jax is only touched when the process already
+    imported it; failures degrade to sampler-only, never fail the
+    capture."""
+    import sys as _sys
+
+    trace_dir = None
+    profiler = None
+    if "jax" in _sys.modules:
+        try:
+            import jax
+
+            if jax.default_backend() != "cpu":
+                import tempfile
+
+                trace_dir = tempfile.mkdtemp(prefix="rt_gang_trace_")
+                jax.profiler.start_trace(trace_dir)
+                profiler = jax
+        except Exception:  # noqa: BLE001 — sampler-only fallback
+            trace_dir = None
+            profiler = None
+    try:
+        result = sample_timeline(
+            duration_s=duration_s, hz=hz, start_at=start_at
+        )
+    finally:
+        if profiler is not None:
+            try:
+                profiler.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                trace_dir = None
+    if trace_dir is not None:
+        result["jax_trace_dir"] = trace_dir
+    return result
+
+
 #: RPC surface: kind -> handler(**params). Registered on the worker's
 #: direct server and reachable through the daemon/head `profile_worker`
-#: relay (dashboard /api/profile).
+#: relay (dashboard /api/profile) — `gang` is the synchronized-window
+#: capture rt.profile_gang fans out.
 def run_profile(kind: str, **params) -> dict:
     if kind == "stack":
         return {"stacks": dump_stacks()}
@@ -154,5 +306,11 @@ def run_profile(kind: str, **params) -> dict:
         return profile_memory(
             duration_s=params.get("duration_s", 5.0),
             top=params.get("top", 20),
+        )
+    if kind == "gang":
+        return capture_gang(
+            duration_s=params.get("duration_s", 2.0),
+            hz=params.get("hz", 100.0),
+            start_at=params.get("start_at"),
         )
     raise ValueError(f"unknown profile kind: {kind!r}")

@@ -146,7 +146,10 @@ def timed_iter(
 #: queue_wait is the decoupled RL dataflow's rollout-queue stall
 #: (rl/dataflow.py bills it) — the learner starving on rollouts,
 #: billed exactly like a trainer starving on input (data_wait);
-#: weight_sync is its drainless weight-publish stall.
+#: weight_sync is its drainless weight-publish stall. compile is XLA
+#: trace+compile time (_private/compile_watch.py bills it on digest
+#: misses) — the cold-compile step's cost, attributed instead of
+#: masquerading as a giant step_ms.
 _TRACE_PHASES = (
     "data_wait_ms",
     "queue_wait_ms",
@@ -155,6 +158,7 @@ _TRACE_PHASES = (
     "weight_sync_ms",
     "send_wait_ms",
     "recv_wait_ms",
+    "compile_ms",
     "step_ms",
 )
 
@@ -235,6 +239,8 @@ def steps_to_chrome_trace(records) -> list:
 #: a learner whose goodput is eaten by queue_wait is runner-bound,
 #: one eaten by weight_sync is sync-bound (doctor's verdict.rl reads
 #: the same attribution from the rl_* series).
+#: compile is XLA's share of the wall: a loop whose goodput is eaten
+#: by compile_ms is recompiling (see verdict.compile), not slow.
 _STALL_PHASES = (
     "data_wait_ms",
     "queue_wait_ms",
@@ -243,6 +249,7 @@ _STALL_PHASES = (
     "weight_sync_ms",
     "send_wait_ms",
     "recv_wait_ms",
+    "compile_ms",
 )
 
 
@@ -399,7 +406,27 @@ def report_step(
         record.update(extra)
     from ..util.metrics import _Buffer
 
-    _Buffer.get().push(
+    buf = _Buffer.get()
+    # Per-rank HBM occupancy from device.memory_stats(), folded into
+    # the same step record (and exported as (job, rank)-labeled
+    # gauges — both bounded, and without the job label two jobs'
+    # same-numbered ranks would clobber one series). None on CPU or
+    # when the runtime exposes no stats: the fields are ABSENT, never
+    # fake zeros that would read as "no pressure".
+    from .compile_watch import device_memory
+
+    hbm = device_memory()
+    if hbm:
+        hbm_tags = (
+            ("job", record["job"]),
+            ("rank", str(int(rank))),
+        )
+        for key, value in hbm.items():
+            record[key] = int(value)
+            buf.push(
+                ("gauge", "rt_" + key, float(value), hbm_tags)
+            )
+    buf.push(
         (
             "step",
             "train_step",

@@ -425,11 +425,18 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # profile_manager — py-spy/memray attach; here in-process)
     "profile": {
         "?kind": str, "?duration_s": _num, "?hz": _num, "?top": int,
+        "?start_at": _num,
     },
     "profile_worker": {
         "pid": int, "?kind": str, "?duration_s": _num,
         "?hz": _num, "?top": int, "?node_id": (bytes, type(None)),
+        "?start_at": _num,
     },
+    # coordinated gang profiling + the head's compile-watch table
+    "profile_gang": {
+        "?job": (str, type(None)), "?duration_s": _num, "?hz": _num,
+    },
+    "compile_summary": {},
     # KV
     "kv_put": {
         "key": (str, bytes), "value": bytes, "?ns": str,
@@ -548,6 +555,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "diagnose": {
         "?hung_task_s": _num, "?straggler_threshold": _num,
         "?capture_stacks": bool, "?limit": int, "?leak_age_s": _num,
+        "?compile_storm_threshold": _num,
     },
     # pubsub / log streaming
     "subscribe_logs": {"?channels": list},

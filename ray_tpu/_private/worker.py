@@ -365,7 +365,9 @@ class CoreWorker:
 
                         params = {
                             k: msg[k]
-                            for k in ("duration_s", "hz", "top")
+                            for k in (
+                                "duration_s", "hz", "top", "start_at",
+                            )
                             if k in msg
                         }
                         result = run_profile(
@@ -451,9 +453,11 @@ class CoreWorker:
         )
         self.node_id = NodeID(reply["node_id"])
         self.config = Config(**reply["config"])
+        from .compile_watch import configure as _compile_configure
         from .flight_recorder import configure as _flight_configure
 
         _flight_configure(self.config)
+        _compile_configure(self.config)
         if role == "driver":
             self.job_id = JobID(reply["job_id"])
             self.worker_id = WorkerID.from_random()

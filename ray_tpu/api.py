@@ -223,6 +223,25 @@ def diagnose(
     ]
 
 
+def profile_gang(
+    job_id: Optional[str] = None,
+    *,
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    path: Optional[str] = None,
+) -> dict:
+    """Coordinated gang profiling: one synchronized profiler window
+    across every rank of a gang, merged — with the gang's
+    step-telemetry phases — into one chrome trace on a shared clock
+    (see `ray_tpu.util.state.profile_gang`; CLI:
+    ``ray_tpu profile --job``)."""
+    from .util.state import profile_gang as _profile_gang
+
+    return _profile_gang(
+        job_id, duration_s=duration_s, hz=hz, path=path
+    )
+
+
 class RuntimeContext:
     """Execution-context introspection (reference:
     python/ray/runtime_context.py:30 RuntimeContext — get_job_id /

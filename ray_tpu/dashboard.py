@@ -63,7 +63,7 @@ _PAGE = """<!doctype html>
 <script>
 const TABS = ["nodes","actors","tasks","objects","memory",
               "placement_groups","resources","metrics","serve",
-              "spans","steps","doctor"];
+              "spans","steps","compile","doctor"];
 let active = "nodes";
 const $ = (id) => document.getElementById(id);
 function tabs() {
@@ -131,7 +131,7 @@ async function tick() {
         "<h3>verdict</h3>" + table(problems);
     } else $("view").innerHTML = table(
       tab === "resources" || tab === "metrics" || tab === "steps" ||
-      tab === "serve"
+      tab === "serve" || tab === "compile"
         ? Object.entries(data).map(([k,v]) => ({name:k, ...(
             typeof v === "object" ? v : {value:v})}))
         : data);
@@ -204,6 +204,7 @@ class Dashboard:
             "serve": self._serve,
             "spans": self._spans,
             "steps": self._steps,
+            "compile": self._compile,
             "doctor": self._doctor,
         }
         fn = handlers.get(kind)
@@ -307,6 +308,34 @@ class Dashboard:
                 )
             },
         }
+
+    @staticmethod
+    def _compile():
+        """/api/compile — the head's XLA compile-watch table: one row
+        per registered program (compile count, total ms, distinct
+        shape digests) plus the current recompile-storm findings."""
+        from ._private.worker import global_worker
+
+        worker = global_worker()
+        if worker is None:
+            return {}
+        summary = worker.call("compile_summary")["compile"]
+        out = {
+            name: {
+                "compiles": row.get("compiles", 0),
+                "total_ms": row.get("total_ms", 0.0),
+                "distinct_shapes": row.get("distinct_shapes", 0),
+            }
+            for name, row in sorted(
+                summary.get("programs", {}).items()
+            )
+        }
+        for i, storm in enumerate(summary.get("storms", [])):
+            out[f"storm {i}"] = {
+                "program": storm.get("program"),
+                "detail": storm.get("detail"),
+            }
+        return out
 
     #: Seconds a doctor verdict is served to polls before refresh:
     #: diagnose fans out per-worker inspect RPCs cluster-wide, far

@@ -501,10 +501,15 @@ class UnboundedMetricLabels(Rule):
     _RECORDERS = ("inc", "set", "observe")
     #: Label keys whose values are per-entity identities. `job` is
     #: deliberately absent: jobs are few and the ledger/goodput series
-    #: key on them by design.
+    #: key on them by design. `digest`/`shape_digest` are the XLA
+    #: compile-watch case: one series per arg-shape set is unbounded
+    #: under exactly the recompile storm the series exists to catch —
+    #: compile metrics carry the program NAME only, digests stay in
+    #: the bounded diagnostic ring (compile_watch.py).
     _BANNED = re.compile(
         r"^(request|object|task|actor|worker|span|trace|lease|"
-        r"session|batch)_?id$|^(oid|tid|rid)$"
+        r"session|batch)_?id$|^(oid|tid|rid)$|"
+        r"^(shape_)?digest$|^shapes?$"
     )
 
     def _flag(self, key: str, where: str, anchor) -> Iterable[Hit]:

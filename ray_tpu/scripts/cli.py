@@ -679,6 +679,19 @@ def cmd_doctor(args) -> None:
             f"  bottleneck [{rl.get('bottleneck', '?')}]: "
             f"{rl.get('detail', '')}"
         )
+    comp = verdict.get("compile") or {}
+    if comp.get("programs"):
+        compiles = sum(
+            row.get("compiles", 0)
+            for row in comp["programs"].values()
+        )
+        print(
+            f"xla: {compiles} compile(s) across "
+            f"{len(comp['programs'])} program(s), "
+            f"{len(comp.get('storms') or ())} recompile storm(s), "
+            f"{len(comp.get('hbm_pressure') or ())} rank(s) under "
+            "HBM pressure"
+        )
     memory = verdict.get("memory") or {}
     if memory:
         print(
@@ -702,6 +715,57 @@ def cmd_doctor(args) -> None:
             for line in str(stack).splitlines():
                 print(f"      {line}")
     sys.exit(1)
+
+
+def cmd_profile(args) -> None:
+    """`ray_tpu profile` — on-demand profiling against a live
+    cluster. Default (and `--job JOB`): COORDINATED GANG PROFILING —
+    one synchronized window across every step-reporting rank of the
+    job, merged with the gang's step-telemetry phases into one chrome
+    trace (`--out`, load in chrome://tracing / Perfetto). With
+    `--pid`: the single-worker profiler (cpu/stack/memory), same as
+    the dashboard's /api/profile. Exit 1 when no rank could be
+    captured."""
+    _connect(args)
+    from ..util import state
+
+    if args.pid is not None:
+        result = state.profile_worker(
+            args.pid,
+            kind=args.kind,
+            duration_s=args.duration_s,
+            hz=args.hz,
+            node_id=args.node,
+        )
+        print(json.dumps(result, indent=2, default=str))
+        return
+    reply = state.profile_gang(
+        args.job,
+        duration_s=args.duration_s,
+        hz=args.hz,
+        path=args.out,
+    )
+    ranks = reply.get("ranks", [])
+    errors = reply.get("errors", {})
+    print(
+        f"job {reply.get('job')}: profiled {len(ranks)} rank(s) for "
+        f"{reply.get('window', {}).get('duration_s', 0):g}s, "
+        f"{len(reply.get('trace', []))} trace slice(s)"
+    )
+    for row in ranks:
+        line = (
+            f"  rank {row['rank']}: {row.get('samples', 0)} samples, "
+            f"{row.get('threads', 0)} thread(s)"
+        )
+        if row.get("jax_trace_dir"):
+            line += f", jax trace: {row['jax_trace_dir']}"
+        print(line)
+    for rank, err in sorted(errors.items()):
+        print(f"  rank {rank}: capture FAILED: {err}")
+    if args.out:
+        print(f"merged chrome trace: {args.out}")
+    if not ranks:
+        sys.exit(1)
 
 
 def cmd_lint(args) -> None:
@@ -955,6 +1019,41 @@ def main(argv=None) -> None:
         help="max rows for tasks/objects (tasks are newest-first)",
     )
     p_sls.set_defaults(fn=cmd_state_ls)
+
+    p_prof = sub.add_parser(
+        "profile",
+        help="profile a gang (synchronized window, merged chrome "
+        "trace) or a single worker",
+    )
+    p_prof.add_argument("--address")
+    p_prof.add_argument(
+        "--job",
+        help="job id (hex) to gang-profile; default: the most "
+        "recently step-reporting job",
+    )
+    p_prof.add_argument(
+        "--pid", type=int, default=None,
+        help="single-worker mode: profile this worker pid instead of "
+        "a gang",
+    )
+    p_prof.add_argument(
+        "--kind", default="cpu", choices=["cpu", "stack", "memory"],
+        help="single-worker profile kind (with --pid)",
+    )
+    p_prof.add_argument(
+        "--node", help="node id (hex) owning --pid (default: head)"
+    )
+    p_prof.add_argument(
+        "--duration-s", type=float, default=2.0, dest="duration_s",
+        help="profile window length (gang windows are capped by "
+        "config profile_gang_max_duration_s)",
+    )
+    p_prof.add_argument("--hz", type=float, default=100.0)
+    p_prof.add_argument(
+        "--out", metavar="TRACE.json",
+        help="write the merged gang chrome trace to this path",
+    )
+    p_prof.set_defaults(fn=cmd_profile)
 
     p_doc = sub.add_parser(
         "doctor",

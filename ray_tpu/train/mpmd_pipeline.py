@@ -244,6 +244,20 @@ class _PipelineStage:
     def _build_programs(self) -> None:
         import jax
 
+        from .._private import compile_watch
+
+        # Every stage program registers with the compile watcher by
+        # name (mpmd.s<stage>.<fwd|bwd>:<chunk> — bounded by the
+        # pipeline topology): per-chunk fwd/bwd must compile once at
+        # warmup and NEVER again, and a microbatch-shape drift that
+        # re-traces a stage mid-training now convicts itself in
+        # `doctor` verdict.compile instead of reading as a slow
+        # stage.
+        def _jit(key: str, fn):
+            return compile_watch.instrument(
+                f"mpmd.s{self.stage}.{key}", jax.jit(fn)
+            )
+
         cfg = self.cfg
         for c, _lo, _hi in self.chunk_specs:
             first = c == 0
@@ -256,10 +270,10 @@ class _PipelineStage:
                         _obj, argnums=(0, 1), has_aux=True
                     )(p, x, t, ic, ascale)
 
-                self._programs[f"bwd:{c}"] = jax.jit(last_bwd)
+                self._programs[f"bwd:{c}"] = _jit(f"bwd:{c}", last_bwd)
             else:
                 fwd = _make_chunk_fwd(cfg, first)
-                self._programs[f"fwd:{c}"] = jax.jit(fwd)
+                self._programs[f"fwd:{c}"] = _jit(f"fwd:{c}", fwd)
                 if first:
 
                     def first_bwd(p, tokens, gy, aux_ct, _fwd=fwd):
@@ -269,7 +283,7 @@ class _PipelineStage:
                         (dp,) = vjp((gy, aux_ct.astype(aux.dtype)))
                         return dp, aux
 
-                    self._programs[f"bwd:{c}"] = jax.jit(first_bwd)
+                    self._programs[f"bwd:{c}"] = _jit(f"bwd:{c}", first_bwd)
                 else:
 
                     def mid_bwd(p, x, gy, aux_ct, _fwd=fwd):
@@ -277,9 +291,9 @@ class _PipelineStage:
                         dp, dx = vjp((gy, aux_ct.astype(aux.dtype)))
                         return dp, dx, aux
 
-                    self._programs[f"bwd:{c}"] = jax.jit(mid_bwd)
-        self._programs["acc"] = jax.jit(
-            lambda a, b: jax.tree.map(jax.numpy.add, a, b)
+                    self._programs[f"bwd:{c}"] = _jit(f"bwd:{c}", mid_bwd)
+        self._programs["acc"] = _jit(
+            "acc", lambda a, b: jax.tree.map(jax.numpy.add, a, b)
         )
         if self._optimizer is not None:
             import optax
@@ -290,7 +304,7 @@ class _PipelineStage:
                 )
                 return optax.apply_updates(params, updates), new_opt
 
-            self._programs["opt"] = jax.jit(opt_update)
+            self._programs["opt"] = _jit("opt", opt_update)
 
     # -- the step ------------------------------------------------------
     def run_step(

@@ -224,10 +224,17 @@ def make_pp_train_step(
             metrics,
         )
 
-    step_fn = jax.jit(
-        _step,
-        in_shardings=(None, batch_sharding, batch_sharding),
-        out_shardings=(None, repl),
-        donate_argnums=(0,) if donate else (),
+    # Same compile-watch contract as make_train_step's "train.step":
+    # one compile per geometry, recompile storms convicted by name.
+    from .._private import compile_watch
+
+    step_fn = compile_watch.instrument(
+        "train.pp_step",
+        jax.jit(
+            _step,
+            in_shardings=(None, batch_sharding, batch_sharding),
+            out_shardings=(None, repl),
+            donate_argnums=(0,) if donate else (),
+        ),
     )
     return init_fn, step_fn

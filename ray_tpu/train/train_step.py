@@ -167,11 +167,22 @@ def make_train_step(
             metrics,
         )
 
-    step_fn = jax.jit(
-        _step,
-        in_shardings=(None, batch_sharding, batch_sharding),
-        out_shardings=(None, repl),
-        donate_argnums=(0,) if donate else (),
+    # Registered with the XLA compile watcher by name: a training
+    # loop's step must compile once per (state, batch) geometry and
+    # never again — a drifting batch shape that re-traces it every
+    # iteration now convicts itself in `doctor` verdict.compile
+    # (recompile_storm) instead of reading as a mysteriously slow
+    # loop, and the cold-compile step bills compile_ms as a stall.
+    from .._private import compile_watch
+
+    step_fn = compile_watch.instrument(
+        "train.step",
+        jax.jit(
+            _step,
+            in_shardings=(None, batch_sharding, batch_sharding),
+            out_shardings=(None, repl),
+            donate_argnums=(0,) if donate else (),
+        ),
     )
     return init_fn, step_fn
 

@@ -116,6 +116,50 @@ def profile_worker(
     )
 
 
+def profile_gang(
+    job_id: Optional[str] = None,
+    *,
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    path: Optional[str] = None,
+) -> dict:
+    """Coordinated gang profiling: fan ONE synchronized start/stop
+    window out to every step-reporting rank of a job (default: the
+    most recently reporting job) and merge the per-rank captures —
+    `jax.profiler` traces on TPU backends, the in-process timeline
+    sampler elsewhere — with the gang's step-telemetry phases into
+    one chrome trace on a shared unix-epoch clock. Returns
+    ``{"job", "trace", "ranks", "errors", "window"}``; with `path`
+    the merged trace is additionally written as chrome-trace JSON
+    (load in chrome://tracing or Perfetto). CLI surface:
+    ``ray_tpu profile --job``."""
+    kwargs: dict = {
+        "duration_s": float(duration_s),
+        "hz": float(hz),
+    }
+    if job_id is not None:
+        kwargs["job"] = str(job_id)
+    reply = _worker().call(
+        "profile_gang",
+        timeout=float(duration_s) + 120.0,
+        **kwargs,
+    )
+    if path is not None:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(reply.get("trace", []), f)
+    return reply
+
+
+def compile_summary() -> dict:
+    """The head's folded XLA compile table: per-program compile
+    counts/durations, the bounded shape-digest rings, and the current
+    recompile-storm findings (`/api/compile`; the cluster half of
+    `_private.compile_watch.snapshot()`)."""
+    return _worker().call("compile_summary")["compile"]
+
+
 __all__ = [
     "list_nodes",
     "list_actors",
@@ -126,4 +170,6 @@ __all__ = [
     "summarize",
     "event_stats",
     "profile_worker",
+    "profile_gang",
+    "compile_summary",
 ]

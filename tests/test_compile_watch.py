@@ -1,0 +1,621 @@
+"""XLA-layer observability tests (ISSUE 15).
+
+Covers the compile watcher end to end: a deliberately shape-unstable
+jitted function must be convicted by `doctor --json` verdict.compile
+(exit 1, program + drifting shape dimension named) while a
+shape-stable loop stays clean; compile_ms bills as a step stall phase
+only on the step that actually compiled; HBM fields are ABSENT (not
+zero/fake) on CPU backends; the hot-path overhead holds the <1%-of-
+smoke-step bar; and a 2-node coordinated gang profile merges per-rank
+sampler slices with step phases into one chrome trace on one clock.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Each test starts with an empty per-process compile registry
+    (the head-side table is fresh per rt.init already)."""
+    from ray_tpu._private import compile_watch
+
+    compile_watch.reset()
+    yield
+    compile_watch.reset()
+
+
+# ---------------------------------------------------------------------------
+# digests / shape deltas (pure host-side, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_arg_digest_keys_on_shape_and_dtype():
+    import numpy as np
+
+    from ray_tpu._private import compile_watch as cw
+
+    a32 = np.zeros((4, 8), np.float32)
+    b32 = np.ones((4, 8), np.float32)  # same shape/dtype, other values
+    wide = np.zeros((4, 16), np.float32)
+    a16 = np.zeros((4, 8), np.float16)
+    assert cw.arg_digest((a32,), {}) == cw.arg_digest((b32,), {})
+    assert cw.arg_digest((a32,), {}) != cw.arg_digest((wide,), {})
+    assert cw.arg_digest((a32,), {}) != cw.arg_digest((a16,), {})
+    # Python scalars digest by TYPE, not value: a traced scalar
+    # changing value must never mint a fake storm.
+    assert cw.arg_digest((a32, 1), {}) == cw.arg_digest((a32, 2), {})
+    assert cw.arg_digest((a32, 1), {}) != cw.arg_digest((a32, 1.0), {})
+    # ...while strings are always jit statics: value matters.
+    assert cw.arg_digest((a32, "mean"), {}) != cw.arg_digest(
+        (a32, "sum"), {}
+    )
+    # Cross-process stability (the head folds digests from many
+    # ranks): the short key is content-derived, not hash()-salted.
+    key = cw.digest_key(cw.arg_digest((a32,), {}))
+    assert key == cw.digest_key(cw.arg_digest((b32,), {}))
+    assert len(key) == 12
+
+
+def test_shape_delta_names_drifting_dimension():
+    import numpy as np
+
+    from ray_tpu._private import compile_watch as cw
+
+    prev = cw.digest_leaves(
+        cw.arg_digest((np.zeros((8, 128), np.int32),), {})
+    )
+    new = cw.digest_leaves(
+        cw.arg_digest((np.zeros((8, 131), np.int32),), {})
+    )
+    delta = cw.shape_delta(prev, new)
+    assert "dim 1" in delta and "i32[8,128]" in delta
+    dtype_new = cw.digest_leaves(
+        cw.arg_digest((np.zeros((8, 128), np.float32),), {})
+    )
+    assert "dtype" in cw.shape_delta(prev, dtype_new)
+    assert "arity" in cw.shape_delta(prev, prev + new)
+
+
+def test_storm_detector_thresholds():
+    from ray_tpu._private import compile_watch as cw
+
+    programs: dict = {}
+    for i in range(6):
+        cw.fold_record(
+            programs,
+            "bucketed.prefill",
+            5.0,
+            {"digest": f"bucket{i}", "leaves": (("int32", (8, 2 ** i)),)},
+        )
+    # 6 distinct digests: a legitimate bucket family, below the
+    # default threshold of 8 — no storm.
+    assert cw.detect_storms(programs, 8) == []
+    for i in range(6, 12):
+        cw.fold_record(
+            programs,
+            "bucketed.prefill",
+            5.0,
+            {"digest": f"bucket{i}", "leaves": (("int32", (8, 2 ** i)),)},
+        )
+    storms = cw.detect_storms(programs, 8)
+    assert len(storms) == 1
+    assert storms[0]["program"] == "bucketed.prefill"
+    assert storms[0]["distinct_shapes"] == 12
+    assert "bucketed.prefill" in storms[0]["detail"]
+
+
+def test_storm_window_ages_out_old_digests():
+    """Distinct shapes accumulated over a cluster's LIFETIME are not
+    a storm: digests older than the window don't count, so a healthy
+    long-lived cluster (warmup buckets + redeploys + successive
+    jobs) goes back to exit 0 once nothing is actively drifting."""
+    import time as _time
+
+    from ray_tpu._private import compile_watch as cw
+
+    programs: dict = {}
+    stale = _time.time() - 2 * cw.STORM_WINDOW_S
+    for i in range(20):
+        cw.fold_record(
+            programs,
+            "longlived.step",
+            5.0,
+            {"digest": f"old{i}", "time": stale + i},
+        )
+    assert cw.detect_storms(programs, 8) == []
+    # The same count of RECENT digests is a storm.
+    for i in range(8):
+        cw.fold_record(
+            programs, "longlived.step", 5.0, {"digest": f"new{i}"}
+        )
+    storms = cw.detect_storms(programs, 8)
+    assert len(storms) == 1
+    assert storms[0]["distinct_shapes"] == 8
+
+
+def test_digest_ring_is_bounded():
+    from ray_tpu._private import compile_watch as cw
+
+    programs: dict = {}
+    for i in range(4 * cw.DIGEST_RING):
+        cw.fold_record(
+            programs, "p", 1.0, {"digest": f"d{i}"}
+        )
+    row = programs["p"]
+    assert row["compiles"] == 4 * cw.DIGEST_RING
+    assert len(row["digests"]) == cw.DIGEST_RING
+
+
+# ---------------------------------------------------------------------------
+# instrumented programs against a live session
+# ---------------------------------------------------------------------------
+
+
+def _drifting_loop(n: int = 12):
+    """A deliberately shape-unstable jitted loop: one dimension grows
+    every iteration — the classic silent recompile storm."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu._private import compile_watch as cw
+
+    fn = cw.instrument(
+        "test.drifting_step", jax.jit(lambda x: (x * 2 + 1).sum())
+    )
+    for i in range(2, n + 2):
+        fn(jnp.asarray(np.zeros((4, i), np.float32)))
+    return fn
+
+
+def test_shape_unstable_loop_convicted_by_doctor(rt_session):
+    rt = rt_session
+    from ray_tpu.util import metrics
+
+    _drifting_loop()
+    metrics.flush()
+    verdict = rt.diagnose(capture_stacks=False)
+    assert verdict["healthy"] is False
+    storms = [
+        p
+        for p in verdict["problems"]
+        if p["kind"] == "recompile_storm"
+    ]
+    assert len(storms) == 1
+    assert storms[0]["program"] == "test.drifting_step"
+    assert storms[0]["compiles"] == 12
+    # The runbook half: the verdict names WHAT drifted, down to the
+    # dimension.
+    assert "dim 1" in storms[0]["delta"]
+    comp = verdict["compile"]
+    assert comp["programs"]["test.drifting_step"]["distinct_shapes"] == 12
+    # The same table is served standalone for the dashboard tab.
+    from ray_tpu.util.state import compile_summary
+
+    summary = compile_summary()
+    assert summary["storms"][0]["program"] == "test.drifting_step"
+
+
+def test_shape_stable_loop_stays_clean(rt_session):
+    rt = rt_session
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu._private import compile_watch as cw
+    from ray_tpu.util import metrics
+
+    fn = cw.instrument(
+        "test.stable_step", jax.jit(lambda x: (x * 2).sum())
+    )
+    x = jnp.zeros((4, 8), jnp.float32)
+    for _ in range(20):
+        fn(x)
+    metrics.flush()
+    verdict = rt.diagnose(capture_stacks=False)
+    assert [
+        p
+        for p in verdict["problems"]
+        if p["kind"] == "recompile_storm"
+    ] == []
+    row = verdict["compile"]["programs"]["test.stable_step"]
+    assert row["compiles"] == 1
+    assert row["distinct_shapes"] == 1
+    assert fn.stats() == {"compiles": 1, "distinct_shapes": 1}
+
+
+def test_doctor_cli_names_program_and_shape_delta(rt_session):
+    """The operator surface: `ray_tpu doctor --json` (a separate
+    process) exits 1 on a recompile storm and its JSON names the
+    program and the drifting dimension."""
+    from ray_tpu.util import metrics
+
+    _drifting_loop()
+    metrics.flush()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    import ray_tpu as rt
+
+    address = rt.api._session.address
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu",
+            "doctor",
+            "--json",
+            "--address",
+            address,
+            "--no-stacks",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    verdict = json.loads(out.stdout)
+    storms = [
+        p
+        for p in verdict["problems"]
+        if p["kind"] == "recompile_storm"
+    ]
+    assert storms and storms[0]["program"] == "test.drifting_step"
+    assert "dim 1" in storms[0]["delta"]
+
+
+def test_compile_ms_bills_only_the_compiling_step(rt_session):
+    """compile_ms is a first-class stall phase: present (and large)
+    on the step whose call compiled, ABSENT on the steady-state steps
+    after it — cold compiles stop polluting steady-state goodput."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu._private import compile_watch as cw
+    from ray_tpu._private.step_telemetry import take_phases
+    from ray_tpu.train import telemetry
+
+    take_phases()  # baseline drain, hand-rolled-loop contract
+    fn = cw.instrument(
+        "test.billed_step", jax.jit(lambda x: (x @ x.T).sum())
+    )
+    x = jnp.zeros((16, 16), jnp.float32)
+    for step in (1, 2, 3):
+        t0 = time.monotonic()
+        fn(x)
+        telemetry.report_step(
+            step, rank=0, wall_ms=(time.monotonic() - t0) * 1e3
+        )
+    records = {r["step"]: r for r in telemetry.step_records()}
+    assert records[1].get("compile_ms", 0.0) > 0.0
+    assert "compile_ms" not in records[2]
+    assert "compile_ms" not in records[3]
+    # Goodput classifies compile as stall, not compute: the compiling
+    # step's residual step_ms must not contain the compile seconds.
+    assert records[1]["step_ms"] <= records[1]["wall_ms"] - records[
+        1
+    ]["compile_ms"] + 1.0
+
+
+def test_hbm_fields_absent_on_cpu(rt_session):
+    """On CPU backends device.memory_stats() is unavailable: the
+    step record carries NO hbm_* fields (absent, never fake zeros)
+    and the verdict reports no HBM pressure."""
+    import jax  # noqa: F401 — ensure jax is loaded, the probed path
+
+    from ray_tpu._private.compile_watch import device_memory
+    from ray_tpu.train import telemetry
+
+    assert device_memory() is None
+    rt = rt_session
+    telemetry.report_step(1, rank=0, wall_ms=25.0, step_ms=20.0)
+    records = telemetry.step_records()
+    assert records
+    for rec in records:
+        for key in rec:
+            assert not key.startswith("hbm_"), rec
+    verdict = rt.diagnose(capture_stacks=False)
+    assert verdict["compile"]["hbm_pressure"] == []
+
+
+def test_hbm_pressure_verdict_names_rank(rt_session):
+    """A step record reporting >=90% of HBM capacity flips the
+    doctor to hbm_pressure naming the rank (fed through the same
+    step-record path a TPU rank would use)."""
+    rt = rt_session
+    from ray_tpu.train import telemetry
+
+    gib = 2 ** 30
+    telemetry.report_step(
+        1,
+        rank=3,
+        wall_ms=100.0,
+        step_ms=90.0,
+        extra={
+            "hbm_bytes_in_use": 15 * gib,
+            "hbm_peak_bytes": 15 * gib,
+            "hbm_bytes_limit": 16 * gib,
+        },
+    )
+    verdict = rt.diagnose(capture_stacks=False)
+    pressure = [
+        p for p in verdict["problems"] if p["kind"] == "hbm_pressure"
+    ]
+    assert len(pressure) == 1
+    assert pressure[0]["rank"] == 3
+    assert pressure[0]["fraction"] == pytest.approx(15 / 16, abs=1e-3)
+    assert "rank 3" in pressure[0]["detail"]
+
+
+def test_unregistered_compiles_never_fake_a_storm(rt_session):
+    """Compiles outside any instrumented program are still counted
+    (program "(unregistered)") but carry no digest — so they can
+    never cross the distinct-shapes storm threshold."""
+    rt = rt_session
+    import jax.numpy as jnp
+
+    from ray_tpu.util import metrics
+
+    # Eager ops with drifting shapes compile un-instrumented.
+    for i in range(2, 12):
+        _ = jnp.ones((i,), jnp.float32) * 2
+    metrics.flush()
+    verdict = rt.diagnose(capture_stacks=False)
+    storms = [
+        p
+        for p in verdict["problems"]
+        if p["kind"] == "recompile_storm"
+    ]
+    assert storms == []
+    row = verdict["compile"]["programs"].get("(unregistered)")
+    if row is not None:  # jax may cache some of these
+        assert row["distinct_shapes"] == 0
+
+
+def test_metrics_exposition_program_label_only(rt_session):
+    """The RT010 cardinality contract by construction: the exported
+    compile series carry the program NAME as their only label — no
+    digest/shape labels ever reach the exposition."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu._private import compile_watch as cw
+    from ray_tpu.util import metrics
+    from ray_tpu.util.prometheus import render_prometheus
+
+    fn = cw.instrument(
+        "test.labels", jax.jit(lambda x: x + 1)
+    )
+    fn(jnp.zeros((4,), jnp.float32))
+    metrics.flush()
+    text = render_prometheus(metrics.metrics_summary())
+    lines = [
+        line
+        for line in text.splitlines()
+        if line.startswith("rt_jax_") and "{" in line
+    ]
+    assert any(
+        'rt_jax_compiles_total{program="test.labels"}' in line
+        for line in lines
+    )
+    for line in lines:
+        labels = line[line.index("{") + 1 : line.index("}")]
+        keys = {
+            part.split("=", 1)[0] for part in labels.split(",")
+        }
+        assert keys <= {"program", "le"}, line
+    # HELP lines ride from metric_defs.PIPE_METRICS.
+    assert "# HELP rt_jax_compiles_total" in text
+
+
+def test_config_kill_switch_and_threshold():
+    """compile_watch honors the cluster config: disabled -> the hot
+    path is a passthrough recording nothing; storm threshold follows
+    compile_storm_threshold."""
+    from ray_tpu._private import compile_watch as cw
+    from ray_tpu._private.config import Config
+
+    try:
+        cw.configure(Config(compile_watch_enabled=False))
+        assert not cw.enabled()
+        fn = cw.instrument("test.disabled", lambda x: x)
+        assert fn(41) == 41
+        assert cw.snapshot() == {}
+        cw.configure(
+            Config(
+                compile_watch_enabled=True,
+                compile_storm_threshold=3,
+            )
+        )
+        assert cw.enabled() and cw.storm_threshold() == 3
+    finally:
+        cw.configure(Config())
+
+
+def test_hot_path_overhead_under_one_percent_of_smoke_step():
+    """The hard bar from ISSUE 15: the per-call hot-path cost of an
+    instrumented program (digest + seen-set lookup) on a realistic
+    train-step argument tree must stay under 1% of the --smoke train
+    step time. Measured against a conservative floor (20 ms) ~40x
+    below the observed smoke step median (~860 ms for the tiny-llama
+    CPU step this box runs), so the test neither inherits the step's
+    run-to-run noise nor flakes when CI runs the suite under load —
+    while still holding the watcher to <0.2 ms/call (typical: ~40
+    µs)."""
+    import jax  # noqa: F401 — the digest fast path needs jax loaded
+    import numpy as np
+
+    from ray_tpu._private import compile_watch as cw
+
+    params = {
+        f"layer_{i}": {
+            "attn": {
+                k: np.zeros((4, 4), np.float32)
+                for k in ("wq", "wk", "wv", "wo")
+            },
+            "mlp": {
+                "w1": np.zeros((4, 8), np.float32),
+                "w2": np.zeros((8, 4), np.float32),
+            },
+        }
+        for i in range(16)
+    }
+    batch = np.zeros((8, 128), np.int32)
+    fn = cw.instrument("test.overhead", lambda *a: None)
+    fn(params, batch, batch)  # the one recorded compile
+    n = 2000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn(params, batch, batch)
+        best = min(best, (time.perf_counter() - t0) / n)
+    overhead_ms = best * 1e3
+    smoke_step_floor_ms = 20.0
+    assert overhead_ms < 0.01 * smoke_step_floor_ms, (
+        f"compile-watch hot path costs {overhead_ms:.4f} ms/call — "
+        f"over 1% of a {smoke_step_floor_ms} ms smoke step"
+    )
+    # The hot path recorded nothing (the seed call records at most
+    # one compile — zero when monitoring proved no XLA work fired).
+    assert fn.stats()["compiles"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# coordinated gang profiling
+# ---------------------------------------------------------------------------
+
+
+def test_profile_gang_requires_step_reporting_gang(rt_session):
+    rt = rt_session
+    with pytest.raises(Exception, match="step-reporting"):
+        rt.profile_gang(duration_s=0.2)
+
+
+@pytest.mark.slow
+def test_gang_profile_two_nodes_one_merged_trace(tmp_path):
+    """E2E (slow): a 2-rank gang across 2 nodes reports step
+    telemetry, then `rt.profile_gang` captures one synchronized
+    window; the merged artifact must parse as chrome-trace JSON with
+    both ranks' sampler slices AND step phases on one epoch-us
+    clock."""
+    from ray_tpu.cluster_utils import Cluster
+
+    import ray_tpu as rt
+
+    c = Cluster(initialize_head=True, head_resources={"CPU": 3.0})
+    c.add_node(num_cpus=3, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+
+        @rt.remote
+        class GangMember:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def report(self):
+                from ray_tpu.train import telemetry
+                from ray_tpu.util import metrics
+
+                for step in range(1, 4):
+                    telemetry.report_step(
+                        step,
+                        rank=self.rank,
+                        wall_ms=20.0,
+                        step_ms=15.0,
+                    )
+                metrics.flush()
+                return self.rank
+
+            def spin(self, duration_s):
+                # Busy work for the sampler to see during the window.
+                t0 = time.monotonic()
+                x = 0
+                while time.monotonic() - t0 < duration_s:
+                    x += sum(range(200))
+                return x
+
+        ranks = [
+            GangMember.remote(0),
+            GangMember.options(
+                resources={"remote_node": 1.0}
+            ).remote(1),
+        ]
+        assert rt.get(
+            [m.report.remote() for m in ranks], timeout=120
+        ) == [0, 1]
+
+        spins = [m.spin.remote(4.0) for m in ranks]
+        out_path = tmp_path / "gang_trace.json"
+        reply = rt.profile_gang(
+            duration_s=1.0, hz=200.0, path=str(out_path)
+        )
+        rt.get(spins, timeout=120)
+
+        assert reply["errors"] == {}
+        assert sorted(r["rank"] for r in reply["ranks"]) == [0, 1]
+        assert all(r["samples"] > 0 for r in reply["ranks"])
+        # The artifact is chrome-trace JSON, both ranks' sampler
+        # slices re-homed under rank-labeled process rows.
+        trace = json.loads(out_path.read_text())
+        assert isinstance(trace, list) and trace
+        sample_pids = {
+            e["pid"] for e in trace if e.get("cat") == "sample"
+        }
+        assert {"rank 0", "rank 1"} <= sample_pids
+        # Step phases of the same job ride the same artifact...
+        step_rows = {
+            e["tid"] for e in trace if e.get("cat") == "step"
+        }
+        assert {"rank 0", "rank 1"} <= step_rows
+        # ...and every slice sits on ONE shared epoch-us clock: all
+        # sampler timestamps fall inside the synchronized window.
+        window = reply["window"]
+        lo = (window["start"] - 1.0) * 1e6
+        hi = (
+            window["start"] + window["duration_s"] + 30.0
+        ) * 1e6
+        for e in trace:
+            if e.get("cat") == "sample":
+                assert lo <= e["ts"] <= hi, e
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+
+def test_doctor_stack_capture_rides_gang_relay(rt_session):
+    """Satellite: the doctor's hung-task stack capture was rewired
+    onto the SAME _profile_target relay the gang profiler uses (one
+    start/stop/collect implementation) — a hung task's verdict still
+    carries its stack."""
+    rt = rt_session
+
+    @rt.remote
+    def hang_for_profile():
+        time.sleep(300)
+
+    ref = hang_for_profile.remote()
+    try:
+        deadline = time.time() + 60
+        hung = []
+        while time.time() < deadline and not hung:
+            verdict = rt.diagnose(hung_task_s=0.5)
+            hung = [
+                p
+                for p in verdict["problems"]
+                if p["kind"] == "hung_task"
+            ]
+            if not hung:
+                time.sleep(0.3)
+        assert hung, "hung task never detected"
+        assert "hang_for_profile" in hung[0].get("stack", ""), hung[0]
+    finally:
+        rt.cancel(ref, force=True)

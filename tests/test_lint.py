@@ -284,6 +284,43 @@ CASES = [
         """,
         False,
     ),
+    (
+        # The XLA compile-series cardinality contract (ISSUE 15): a
+        # per-shape-digest label mints one series per arg-shape set —
+        # unbounded under exactly the recompile storm the series
+        # exists to catch.
+        "RT010",
+        "user/compile_metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter
+
+        compiles = Counter(
+            "my_compiles_total", tag_keys=("program", "digest")
+        )
+
+        def record(hist, shape_digest, ms):
+            hist.observe(ms, tags={"shape_digest": shape_digest})
+        """,
+        True,
+    ),
+    (
+        # ...while the program NAME alone (a bounded registered
+        # family) is the sanctioned label — the shape of
+        # rt_jax_compiles_total / rt_jax_compile_ms.
+        "RT010",
+        "user/compile_metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        compiles = Counter(
+            "my_compiles_total", tag_keys=("program",)
+        )
+
+        def record(hist, program, ms):
+            hist.observe(ms, tags={"program": program})
+        """,
+        False,
+    ),
 ]
 
 
