@@ -1326,6 +1326,36 @@ def run_micro() -> dict:
         _cw_trial, digits=3
     )
 
+    # 0a2. lock-witness overhead (ISSUE 16): per acquire/release PAIR
+    # of an instrumented nested-lock pair in steady state (the order
+    # edge already recorded — first sighting pays the one-time stack
+    # capture). The OFF cost is structurally zero (make_lock hands out
+    # raw threading locks, no wrapper), so only the on-cost is a
+    # number worth tracking; tests/test_concurrency_analysis.py holds
+    # it under 1% of a smoke step.
+    from ray_tpu.devtools import lock_witness as _lw
+
+    def _lw_trial() -> float:
+        _lw.install()
+        outer = _lw.make_lock("bench.outer")
+        inner = _lw.make_lock("bench.inner")
+        with outer:
+            with inner:  # seed the order edge (stack capture here)
+                pass
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with outer:
+                with inner:
+                    pass
+        dt = (time.perf_counter() - t0) / n * 1e6
+        _lw.uninstall()
+        return dt
+
+    results["lock_witness_overhead_us"] = _micro_case_from(
+        _lw_trial, digits=3
+    )
+
     # 0b. RL rollout queue: put + get cycle rate (ISSUE 13). Pure
     # host-side bookkeeping on the decoupled dataflow's hand-off hot
     # path — both staleness gates evaluated per put, occupancy

@@ -49,7 +49,7 @@ def load_library() -> Optional[ctypes.CDLL]:
         # An RT_NATIVE_SO override is loaded as-is (pre-built).
         if _SO_OVERRIDE is None:
             try:
-                subprocess.run(
+                subprocess.run(  # rt: noqa[RT203] — build-once gate: holding _build_lock across the build IS the serialization
                     ["make", "-C", _DIR],
                     check=True,
                     capture_output=True,

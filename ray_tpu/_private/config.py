@@ -195,6 +195,21 @@ class Config:
     #: seconds after its creation whose owner process died (or whose
     #: job ended) is named a leak suspect.
     doctor_leak_age_s: float = 300.0
+    #: Runtime lock-order witness (devtools/lock_witness.py): wraps
+    #: the hot-path locks created through `make_lock` so the process
+    #: records its ACTUAL lock-acquisition-order graph plus
+    #: held-while-blocking events into the flight recorder, cycle-
+    #: checked at exit and by `rt.diagnose()` (verdict.locks). Off by
+    #: default — enable with RT_lock_witness_enabled=1 in the
+    #: environment BEFORE the cluster starts so daemons and workers
+    #: (which inherit the env) wrap their locks from birth; when off,
+    #: `make_lock` returns raw threading locks (zero overhead — the
+    #: wrapper is not installed, there is no runtime branch).
+    lock_witness_enabled: bool = False
+    #: Cap on distinct lock-order edges the witness tracks per
+    #: process; first-seen edges keep their acquisition stacks,
+    #: overflow increments a dropped counter in the snapshot.
+    lock_witness_max_edges: int = 4096
     #: XLA compile watcher (_private/compile_watch.py): per-process
     #: listener recording every compilation of a registered jitted
     #: program as (name, shape digest, duration) — compile counters

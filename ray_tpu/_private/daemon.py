@@ -74,6 +74,7 @@ from .placement_groups import (
 from .policies import NodeView, PlacementPolicy
 from .rpc import DEFERRED, Connection, RpcClient, RpcError, RpcServer
 from .scheduler import LocalScheduler, ResourceSet
+from ray_tpu.devtools.lock_witness import make_lock
 
 # Object entry states.
 PENDING = "PENDING"
@@ -273,6 +274,11 @@ class NodeDaemon:
         NodeManagerService port, node_manager.proto:406)."""
         self.session_dir = session_dir
         self.config = config
+        # Before any make_lock() below: the witness only instruments
+        # locks created after it is installed.
+        from ray_tpu.devtools.lock_witness import configure as _witness_configure
+
+        _witness_configure(config)
         self.is_head = is_head
         self.node_id = NodeID.from_random()
         self.socket_path = os.path.join(session_dir, "hostd.sock")
@@ -299,7 +305,7 @@ class NodeDaemon:
                     session_dir, "spilled_objects", self.node_id.hex()[:8]
                 )
             )
-        self._spill_lock = threading.Lock()
+        self._spill_lock = make_lock("daemon.spill")
         # Primary-copy pins: the daemon holds a read pin on every object
         # sealed by a local client so LRU eviction can never destroy the
         # only copy — store-full becomes a spill trigger instead
@@ -310,7 +316,7 @@ class NodeDaemon:
         self.resources = dict(resources)
         self.labels = dict(labels or {})
 
-        self._lock = threading.RLock()
+        self._lock = make_lock("daemon.state", "rlock")
         # Core metrics (reference: stats/metric_defs.cc central
         # registry): monotonic event counters bumped at the few sites
         # where things happen; gauges computed at scrape
@@ -463,7 +469,7 @@ class NodeDaemon:
         # (reentrant: a local commit inside the 2PC may re-enter
         # scheduling); the non-blocking gate stops _schedule()-driven
         # retries from recursing (place -> commit -> _schedule -> place).
-        self._pg_mutex = threading.RLock()
+        self._pg_mutex = make_lock("daemon.pg", "rlock")
         self._pg_retry_gate = threading.Lock()
         # Node-only state.
         self.head: Optional[RpcClient] = None
@@ -527,6 +533,7 @@ class NodeDaemon:
             # flight recorder / stall doctor (all nodes; diagnose and
             # step_summary forward to the head)
             "flight_recorder",
+            "lock_witness",
             "worker_inspect",
             "step_summary",
             "diagnose",
@@ -2261,7 +2268,7 @@ class NodeDaemon:
                 from .._native import NativeArena
 
                 arena = NativeArena.attach(path)
-                self._peer_arenas[path] = arena
+                self._peer_arenas[path] = arena  # rt: noqa[RT201] — worst case is a duplicate NativeArena.attach of the same file (harmless); shutdown() only overlaps at process exit
             pinned = arena.try_pin(oid.binary())
         except Exception:
             return False
@@ -2698,6 +2705,7 @@ class NodeDaemon:
         """Head forwarded a task to run on this node."""
         spec = msg["spec"]
         task_id = TaskID(spec["task_id"])
+        re_report = None
         with self._lock:
             if spec["kind"] == "actor_creation":
                 aid = ActorID(spec["actor_id"])
@@ -2708,14 +2716,23 @@ class NodeDaemon:
                     # (or still runs). Re-report instead of duplicating
                     # the instance.
                     if host.worker_conn_id is not None:
-                        self._control_actor_created(
-                            aid, False, self.node_id.binary()
-                        )
-                    return {}
-                self.actor_hosts[aid] = ActorHost(spec)
-            self.tasks[task_id] = TaskEntry(
-                spec=spec, retries_left=spec.get("max_retries", 0)
+                        re_report = aid
+                    else:
+                        return {}
+                else:
+                    self.actor_hosts[aid] = ActorHost(spec)
+            if re_report is None:
+                self.tasks[task_id] = TaskEntry(
+                    spec=spec, retries_left=spec.get("max_retries", 0)
+                )
+        if re_report is not None:
+            # On worker nodes this is a synchronous RPC to the head —
+            # a slow head must never wedge this node's dispatch lock
+            # (every other handler and the heartbeat block on it).
+            self._control_actor_created(
+                re_report, False, self.node_id.binary()
             )
+            return {}
         self.scheduler.enqueue(
             task_id, ResourceSet(spec.get("resources", {})), spec
         )
@@ -3071,18 +3088,14 @@ class NodeDaemon:
         actor_id = ActorID(msg["actor_id"])
         failed = msg["failed"]
         node_id = msg["node_id"]
+        killed_mid_creation = False
         with self._lock:
             runtime = self.actor_runtimes.get(actor_id)
             if runtime is None:
                 return {}
             if runtime.info.state == ACTOR_DEAD:
-                # Killed while the creation task was queued/running: do
-                # not resurrect; recycle the hosting worker so actor
-                # state can't leak into later tasks.
-                if not failed:
-                    self._kill_host_worker(actor_id, node_id)
-                return {}
-            if failed:
+                killed_mid_creation = True
+            elif failed:
                 runtime.info.state = ACTOR_DEAD
                 pending = list(runtime.pending)
                 runtime.pending.clear()
@@ -3090,6 +3103,15 @@ class NodeDaemon:
                 runtime.info.state = ACTOR_ALIVE
                 runtime.node = node_id
                 pending = []
+        if killed_mid_creation:
+            # Killed while the creation task was queued/running: do
+            # not resurrect; recycle the hosting worker so actor state
+            # can't leak into later tasks. The kill may RPC another
+            # node — never under the head's state lock (a slow node
+            # would wedge the whole control plane for the timeout).
+            if not failed:
+                self._kill_host_worker(actor_id, node_id)
+            return {}
         if failed:
             self.control.update_actor_state(
                 actor_id, ACTOR_DEAD, death_cause="creation task failed"
@@ -4699,7 +4721,7 @@ class NodeDaemon:
             return self.head.call(
                 "request_resources", bundles=msg["bundles"]
             )
-        self._resource_requests = [
+        self._resource_requests = [  # rt: noqa[RT201] — REPLACE semantics by design: a single atomic list store, latest caller wins
             dict(b) for b in msg["bundles"] if b
         ]
         return {"count": len(self._resource_requests)}
@@ -5369,7 +5391,7 @@ class NodeDaemon:
         now = time.time()
         if now - self._memory_folded_at < max_age_s:
             return
-        self._memory_folded_at = now
+        self._memory_folded_at = now  # rt: noqa[RT201] — rate-limit timestamp: a lost update means one extra idempotent fold in the same window
         self._memory_ledger.fold(self._node_memory_report())
 
     def _h_memory_report(self, conn, msg):
@@ -5507,6 +5529,54 @@ class NodeDaemon:
             ),
             "summary": rec.summary(),
         }
+
+    def _h_lock_witness(self, conn, msg):
+        """Pull lock-witness state. No routing args: THIS daemon's
+        snapshot. `pid`: a local worker's (over its direct endpoint).
+        `node_id`: routed driver -> head -> owning daemon. With
+        `all_workers`, the daemon folds its own snapshot plus every
+        local worker's into one `procs` list — the doctor's one-RPC-
+        per-node pull. A disabled process answers {"enabled": False}
+        (the witness never turns on implicitly)."""
+        from ray_tpu.devtools.lock_witness import snapshot
+
+        fwd = {
+            k: msg[k] for k in ("pid", "all_workers") if k in msg
+        }
+        reply = self._relay_to_node(
+            "lock_witness", msg.get("node_id"), 30.0, **fwd
+        )
+        if reply is not None:
+            return reply
+        pid = msg.get("pid")
+        if pid and pid != os.getpid():
+            return self._call_worker_direct(pid, "lock_witness", 10.0)
+        own = snapshot()
+        own["node_id"] = self.node_id.binary()
+        if not msg.get("all_workers"):
+            return own
+        with self._lock:
+            targets = [
+                (w.pid, w.direct_address)
+                for w in self.workers.values()
+            ]
+        procs = [own]
+        for wpid, addr in targets:
+            if not addr:
+                continue
+            try:
+                client = RpcClient(addr, connect_timeout=2.0)
+                try:
+                    row = client.call("lock_witness", timeout=5.0)
+                finally:
+                    client.close()
+                row["node_id"] = self.node_id.binary()
+                procs.append(row)
+            except RpcError:
+                # An unreachable worker is the doctor's inspect
+                # finding, not a witness finding.
+                continue
+        return {"procs": procs}
 
     def _h_worker_inspect(self, conn, msg):
         """Current in-flight tasks of every local worker (with
@@ -5790,9 +5860,22 @@ class NodeDaemon:
             if client is not None:
                 remote.append((info.node_id.hex(), client))
 
+        witness_procs: list = []
+        try:
+            own = self._h_lock_witness(conn, {"all_workers": True})
+            witness_procs.extend(own.get("procs", [own]))
+        except Exception as e:  # rt: noqa[RT007] — diagnose still replies; the gap is folded into the verdict below, not dropped
+            problems.append(
+                {
+                    "kind": "unreachable_node",
+                    "node_id": self.node_id.hex(),
+                    "detail": f"head lock-witness pull failed: {e!r}",
+                }
+            )
+
         def pull_node(target):
-            # A node's two calls run sequentially on its own
-            # (dedicated) client; nodes pull concurrently.
+            # A node's calls run sequentially on its own (dedicated)
+            # client; nodes pull concurrently.
             node_hex, client = target
             try:
                 workers = client.call(
@@ -5801,13 +5884,20 @@ class NodeDaemon:
                 summary = client.call(
                     "flight_recorder", timeout=15.0, limit=1
                 )["summary"]
-                return node_hex, workers, summary, None
+                witness = client.call(
+                    "lock_witness", timeout=15.0, all_workers=True
+                ).get("procs", [])
+                return node_hex, workers, summary, witness, None
             except RpcError as e:
-                return node_hex, [], None, str(e)
+                return node_hex, [], None, [], str(e)
 
-        for node_hex, workers, summary, err in self._parallel_map(
-            pull_node, remote
-        ):
+        for (
+            node_hex,
+            workers,
+            summary,
+            witness,
+            err,
+        ) in self._parallel_map(pull_node, remote):
             if err is not None:
                 problems.append(
                     {
@@ -5819,6 +5909,23 @@ class NodeDaemon:
                 continue
             inspects.extend(workers)
             ring_digests[node_hex] = summary
+            witness_procs.extend(witness)
+        # Lock-order witness: any process whose RECORDED acquisition
+        # graph contains a cycle has already interleaved lock orders
+        # that can deadlock — promoted to a problem (doctor exits 1)
+        # with both sides' acquiring stacks.
+        locks = self._lock_verdict(witness_procs)
+        for row in locks["cycles"]:
+            problems.append(
+                {
+                    "kind": "lock_order_inversion",
+                    "node_id": row["node_id"],
+                    "pid": row["pid"],
+                    "locks": row["locks"],
+                    "legs": row["legs"],
+                    "detail": row["detail"],
+                }
+            )
         # A task that reported step telemetry within the deadline is
         # making progress — a long-lived in-flight train loop, not a
         # hang (a gang fit task runs ONE task for the whole job;
@@ -5964,6 +6071,7 @@ class NodeDaemon:
                 "rl": rl,
                 "compile": compile_verdict,
                 "memory": memory,
+                "locks": locks,
                 "rpc": ring_digests,
                 "nodes": {
                     "total": summary["nodes"],
@@ -5975,6 +6083,49 @@ class NodeDaemon:
                     "leak_age_s": leak_age_s,
                 },
             }
+        }
+
+    def _lock_verdict(self, procs: list) -> dict:
+        """`verdict.locks`: cluster-wide fold of per-process
+        lock-witness snapshots — observed order-graph cycles (each leg
+        carries the stack that first created that edge) and
+        held-while-blocking ledgers. Empty/disabled processes fold to
+        a quiet verdict; `enabled` says whether ANY process ran the
+        witness, so a clean verdict with the witness off is not
+        mistaken for a clean run."""
+        enabled_procs = [p for p in procs if p.get("enabled")]
+        cycles: list = []
+        blocking: list = []
+        dropped = 0
+        for proc in enabled_procs:
+            node_hex = NodeID(proc["node_id"]).hex()
+            pid = proc.get("pid")
+            dropped += int(proc.get("dropped_edges", 0))
+            for legs in proc.get("cycles", ()):
+                names = [leg["from"] for leg in legs]
+                cycles.append(
+                    {
+                        "node_id": node_hex,
+                        "pid": pid,
+                        "locks": names,
+                        "legs": legs,
+                        "detail": (
+                            f"pid {pid} on node {node_hex[:12]} "
+                            "acquired locks in a cyclic order: "
+                            + " -> ".join(names + names[:1])
+                        ),
+                    }
+                )
+            for row in proc.get("held_blocking", ()):
+                blocking.append(
+                    dict(row, node_id=node_hex, pid=pid)
+                )
+        return {
+            "enabled": bool(enabled_procs),
+            "procs": len(enabled_procs),
+            "cycles": cycles,
+            "held_blocking": blocking,
+            "dropped_edges": dropped,
         }
 
     def _compile_verdict(

@@ -55,6 +55,7 @@ from .ids import ObjectID
 from .rpc import ConnectionLost, RpcClient, RpcError
 from .task_spec import make_error_payload
 from .wire import decode_spec, encode_spec, encode_spec_batch
+from ray_tpu.devtools.lock_witness import make_lock
 
 #: In-flight request cap per leased connection when batching is OFF
 #: (config task_submit_batching=False). 1 = every task lands on an
@@ -231,7 +232,9 @@ class _KeyState:
         from collections import deque
 
         self.queue = deque()
-        self.lock = threading.Lock()
+        # One shared witness name for every key-state: two instances
+        # are never nested, so merging their order edges is safe.
+        self.lock = make_lock("direct.keystate")
         self.leases: Dict[str, _Lease] = {}
         self.requests_in_flight = 0
         self.closed = False
@@ -263,7 +266,7 @@ class DirectTaskManager:
         # future whose hold_refs chain ObjectRef.__del__ ->
         # remove_local_ref -> forget() on the SAME thread (cyclic GC
         # fires during the pop). A plain Lock self-deadlocks there.
-        self._lock = threading.RLock()
+        self._lock = make_lock("direct.manager", "rlock")
         self._futures: Dict[bytes, Tuple[ResultFuture, int]] = {}
         #: direct results already published to the daemon object table
         #: (large/shm results are implicitly published by the worker).
@@ -1112,7 +1115,8 @@ class ActorDirectRouter:
             # the actor restarts and answers with the NEW worker once
             # ALIVE (or empty if it stays dead).
             self._teardown_client()
-            self._mode = "resolving"
+            with self._cond:
+                self._mode = "resolving"
             if spec.get("max_retries", 0) > 0:
                 spec["max_retries"] -= 1
                 rearm = False
@@ -1132,8 +1136,10 @@ class ActorDirectRouter:
         fut.fulfill(reply.get("results"), reply.get("error"))
 
     def _resolve(self) -> Optional[RpcClient]:
-        if self._client is not None:
-            return self._client
+        with self._cond:
+            client = self._client
+        if client is not None:
+            return client
         # Retry around the window where the actor's worker died but the
         # daemon hasn't processed the death yet: actor_address still
         # answers the OLD address (connect fails) until the daemon sees
@@ -1151,13 +1157,20 @@ class ActorDirectRouter:
             if not address:
                 break  # remote node / dead — daemon path owns it
             try:
-                self._client = RpcClient(address, connect_timeout=0.5)
+                client = RpcClient(address, connect_timeout=0.5)
             except ConnectionLost:
                 time.sleep(min(0.02 * (attempt + 1), 0.2))
                 continue
-            self._mode = "direct"
-            return self._client
-        self._mode = "daemon"
+            # Publish under _cond: the reply-reader thread's
+            # _teardown_client swaps this attribute concurrently — an
+            # unguarded store here could leak the client it replaces
+            # (never closed) or hand back one already being closed.
+            with self._cond:
+                self._client = client
+                self._mode = "direct"
+            return client
+        with self._cond:
+            self._mode = "daemon"
         return None
 
     def _send_daemon(self, spec: dict, fut: ResultFuture) -> None:
@@ -1177,12 +1190,17 @@ class ActorDirectRouter:
             fut.hold_refs = None  # daemon owns arg pinning now
 
     def _teardown_client(self) -> None:
-        if self._client is not None:
+        # Swap under the lock, close outside it: exactly one caller
+        # wins the swap (no double-close when the reader thread and
+        # shutdown() race), and the potentially-blocking socket close
+        # never runs while holding _cond.
+        with self._cond:
+            client, self._client = self._client, None
+        if client is not None:
             try:
-                self._client.close()
+                client.close()
             except Exception:
                 pass
-            self._client = None
 
     def shutdown(self) -> None:
         self._shutdown = True

@@ -122,7 +122,7 @@ class MemoryMonitor:
         victim = pick_victim(self._get_candidates())
         if victim is None:
             return False
-        self._last_kill = time.time()
+        self._last_kill = time.time()  # rt: noqa[RT201] — only the monitor loop calls tick() in production; the public method exists for single-threaded tests
         self._kill_worker(victim)
         return True
 

@@ -82,5 +82,5 @@ class Session:
         finally:
             self.worker = None
             if self.daemon is not None:
-                daemon, self.daemon = self.daemon, None
+                daemon, self.daemon = self.daemon, None  # rt: noqa[RT201] — atexit.unregister above runs before teardown: the finalizer and a live caller never overlap
                 daemon.shutdown()

@@ -61,6 +61,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from .event_stats import stats as _event_stats
 from .flight_recorder import recorder as _flight
+from ray_tpu.devtools.lock_witness import note_blocking as _note_blocking
 from .wire import (
     PROTOCOL_VERSION,
     ProtocolVersionError,
@@ -1259,6 +1260,10 @@ class RpcClient:
             raise RpcError(f"{method}: {err}")
 
     def _call_once(self, method, timeout, kwargs) -> dict:
+        # Dynamic RT203: convict any caller that reaches a synchronous
+        # RPC while holding a witness-instrumented lock (one module-
+        # global read when the witness is off).
+        _note_blocking(f"rpc.call:{method}")
         rec = _flight()
         if rec.enabled:
             t0 = time.monotonic()
@@ -1400,7 +1405,7 @@ class RpcClient:
                 self._sock.close()
             except OSError:
                 pass
-            sock, key = self._connect(10.0)
+            sock, key = self._connect(10.0)  # rt: noqa[RT203] — _reconnect_lock intentionally serializes reconnect attempts, backoff included
             # Swap + generation bump + flush as one atomic step under
             # _send_lock: senders record their send generation while
             # holding it, so nothing can send during the swap and every

@@ -549,6 +549,10 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?limit": int, "?kinds": (list, type(None)),
         "?pid": int, "?node_id": (bytes, type(None)),
     },
+    "lock_witness": {
+        "?pid": int, "?node_id": (bytes, type(None)),
+        "?all_workers": bool,
+    },
     "inspect": {},
     "worker_inspect": {"?node_id": (bytes, type(None))},
     "step_summary": {"?limit": int, "?records": bool},

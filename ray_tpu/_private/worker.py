@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions as exc
+from ..devtools.lock_witness import make_lock
 from ..object_ref import ObjectRef
 from .config import Config
 from .flight_recorder import recorder as _flight
@@ -422,9 +423,18 @@ class CoreWorker:
             self._direct_server.register(
                 "flight_recorder", _h_flight_recorder
             )
+
+            def _h_lock_witness(conn, msg):
+                from ray_tpu.devtools.lock_witness import snapshot
+
+                return snapshot()
+
+            self._direct_server.register(
+                "lock_witness", _h_lock_witness
+            )
             self._direct_server.start()
         self._direct_task_counts = {
-            "lock": threading.Lock(),
+            "lock": make_lock("worker.direct_counts"),
             "finished": 0,
             "failed": 0,
             "events": [],
@@ -455,9 +465,13 @@ class CoreWorker:
         self.config = Config(**reply["config"])
         from .compile_watch import configure as _compile_configure
         from .flight_recorder import configure as _flight_configure
+        from ray_tpu.devtools.lock_witness import (
+            configure as _witness_configure,
+        )
 
         _flight_configure(self.config)
         _compile_configure(self.config)
+        _witness_configure(self.config)
         if role == "driver":
             self.job_id = JobID(reply["job_id"])
             self.worker_id = WorkerID.from_random()
@@ -1591,7 +1605,7 @@ class CoreWorker:
         start_time = time.time()
         task_id = TaskID(spec["task_id"])
         tid_hex = task_id.hex()
-        self._inflight_tasks[tid_hex] = {
+        self._inflight_tasks[tid_hex] = {  # rt: noqa[RT201] — per-task dict key: concurrent pool threads touch distinct keys (GIL-atomic setitem)
             "task_id": tid_hex,
             "name": spec.get("name", ""),
             "kind": spec.get("kind", "normal"),
@@ -1612,13 +1626,13 @@ class CoreWorker:
         # were CREATED under — it is their identity's namespace — even
         # if a later caller runs in another one.
         if spec["kind"] == "actor_creation":
-            self._actor_namespace = spec.get("ns_ctx")
+            self._actor_namespace = spec.get("ns_ctx")  # rt: noqa[RT201] — set once by the creation task, which happens-before any concurrent actor call
         if spec["kind"] in ("actor_creation", "actor_task"):
-            self.namespace = self._actor_namespace or DEFAULT_NAMESPACE
+            self.namespace = self._actor_namespace or DEFAULT_NAMESPACE  # rt: noqa[RT201] — set once by the creation task, which happens-before any concurrent actor call
         else:
             self.namespace = spec.get("ns_ctx") or DEFAULT_NAMESPACE
         if self.job_id._bytes != spec["job_id"]:
-            self.job_id = JobID(spec["job_id"])
+            self.job_id = JobID(spec["job_id"])  # rt: noqa[RT201] — set once per task prologue; normal tasks run one at a time on this worker
         trace_stack = None
         try:
             tctx = spec.get("trace_ctx")
@@ -1654,9 +1668,9 @@ class CoreWorker:
             with env_ctx:
                 if kind == "actor_creation":
                     cls = self.functions.fetch(spec["function_key"])
-                    self._actor_instance = cls(*args, **kwargs)
-                    self._actor_id = ActorID(spec["actor_id"])
-                    self._actor_pg_context = spec.get("pg_context")
+                    self._actor_instance = cls(*args, **kwargs)  # rt: noqa[RT201] — creation task publishes the instance before the daemon routes any calls to it
+                    self._actor_id = ActorID(spec["actor_id"])  # rt: noqa[RT201] — creation task publishes before any concurrent actor call exists
+                    self._actor_pg_context = spec.get("pg_context")  # rt: noqa[RT201] — creation task publishes before any concurrent actor call exists
                     concurrency = int(spec.get("max_concurrency") or 1)
                     groups = spec.get("concurrency_groups") or {}
                     if concurrency > 1 or groups:
@@ -1673,13 +1687,13 @@ class CoreWorker:
                         # into every other group.
                         import concurrent.futures
 
-                        self._actor_pool = (
+                        self._actor_pool = (  # rt: noqa[RT201] — pool built during creation, before the concurrency it provides exists
                             concurrent.futures.ThreadPoolExecutor(
                                 max_workers=concurrency,
                                 thread_name_prefix="rt-actor-exec",
                             )
                         )
-                        self._actor_group_pools = {
+                        self._actor_group_pools = {  # rt: noqa[RT201] — group pools built during creation, before the concurrency they provide exists
                             gname: concurrent.futures.ThreadPoolExecutor(
                                 max_workers=int(width),
                                 thread_name_prefix=f"rt-actor-{gname}",

@@ -219,7 +219,7 @@ class ForkServerClient:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
-            ready, _, _ = select.select([fd], [], [], remaining)
+            ready, _, _ = select.select([fd], [], [], remaining)  # rt: noqa[RT203] — _lock serializes the whole request/reply conversation; this select IS the reply wait
             if not ready:
                 return None
             chunk = os.read(fd, 65536)

@@ -202,7 +202,12 @@ def diagnose(
     the profile relay), unresponsive workers, dead nodes — plus
     `verdict.memory`: nodes near arena capacity, object-leak
     suspects held past `leak_age_s` by dead owners, and spill
-    thrash. The CLI surface is `ray_tpu doctor`; thresholds default
+    thrash — plus `verdict.locks`: observed lock-order inversion
+    cycles and held-while-blocking sites from every process running
+    the lock witness (`RT_lock_witness_enabled=1`; each cycle is
+    also a `lock_order_inversion` problem, so the doctor's exit
+    code covers deadlock risk). The CLI surface is
+    `ray_tpu doctor`; thresholds default
     to the cluster config (`doctor_hung_task_s`,
     `doctor_straggler_threshold`, `doctor_leak_age_s`)."""
     kwargs: Dict[str, Any] = {"capture_stacks": capture_stacks}

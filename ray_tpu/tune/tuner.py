@@ -60,7 +60,7 @@ class _TrialActor:
                 status, error = "error", e
             finally:
                 set_active(None)
-            self._done = (status, error)
+            self._done = (status, error)  # rt: noqa[RT201] — single-producer handoff: the store is GIL-atomic and published via the results-queue sentinel
             self._runtime.results.put({"__done__": status})
 
         self._thread = threading.Thread(target=run, daemon=True)

@@ -16,6 +16,7 @@ import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions as exc
+from ..devtools.lock_witness import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +56,7 @@ class _Buffer:
 
     def __init__(self):
         self.records: List[tuple] = []
-        self.records_lock = threading.Lock()
+        self.records_lock = make_lock("metrics.records")
         self._stop = threading.Event()
         self._warned = False
         self._sender = uuid.uuid4().hex
