@@ -66,6 +66,8 @@ __all__ = [
     "enabled",
     "storm_threshold",
     "reset",
+    "load_inventory",
+    "static_hint",
     "WatchedFunction",
 ]
 
@@ -743,3 +745,93 @@ def device_memory() -> Optional[Dict[str, int]]:
     if limit > 0:
         out["hbm_bytes_limit"] = limit
     return out
+
+
+# ---------------------------------------------------------------------
+# static inventory bridge (devtools/accel.py <-> verdict.compile)
+# ---------------------------------------------------------------------
+
+#: Cached program inventory (or False after a failed load, so a
+#: broken environment probes the filesystem exactly once).
+_inventory: Any = None
+
+
+def load_inventory(path: Optional[str] = None, *, refresh: bool = False):
+    """The static half of the bridge: the program inventory produced
+    by ``ray_tpu devtools accel --inventory`` (every jit/shard_map
+    wrap site, its registered program name, and its RT302
+    recompile-hazard sites). Resolution order: explicit `path` arg ->
+    ``RT_accel_inventory`` env var (a JSON file, for clusters whose CI
+    exports the inventory as an artifact) -> a lazy in-process scan of
+    the installed package. Returns the inventory dict or None;
+    failures are cached so the doctor path never pays the scan twice."""
+    global _inventory
+    if refresh:
+        _inventory = None
+    if _inventory is not None:
+        return _inventory or None
+    src = path or os.environ.get("RT_accel_inventory")
+    try:
+        if src:
+            import json
+
+            with open(src) as f:
+                _inventory = json.load(f)
+        else:
+            from ray_tpu.devtools.accel import build_inventory
+
+            pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            _inventory = build_inventory([pkg])
+    except Exception:  # noqa: BLE001 — a hint source must never break diagnose
+        _inventory = False
+        return None
+    return _inventory or None
+
+
+def static_hint(program: str) -> Optional[str]:
+    """Resolve a live program name (as seen in a recompile storm) to
+    its static wrap site and any RT302 hazards the analyzer proved
+    there. Literal inventory names match exactly; f-string program
+    names were inventoried as fnmatch patterns (``engine.run[*]``).
+    Returns a one-line human hint or None when the bridge has nothing
+    — absence of a hint must read as 'unknown', not 'clean'."""
+    inv = load_inventory()
+    if not inv:
+        return None
+    import re
+
+    def _pattern_matches(pattern: str, name: str) -> bool:
+        # Program names legitimately contain fnmatch metacharacters
+        # (`engine.run[gen3]`), so only `*` is a wildcard — everything
+        # else matches literally.
+        parts = (re.escape(p) for p in pattern.split("*"))
+        return re.fullmatch(".*".join(parts), name) is not None
+
+    match = None
+    for rec in inv.get("programs", ()):
+        name = rec.get("program")
+        if not name:
+            continue
+        if rec.get("name_kind") == "literal":
+            if name == program:
+                match = rec
+                break
+        elif _pattern_matches(name, program) and match is None:
+            match = rec
+    if match is None:
+        return None
+    site = f"{match['path']}:{match['line']}"
+    hazards = match.get("hazards") or []
+    if hazards:
+        spots = "; ".join(
+            f"{h['path']}:{h['line']} {h['message']}" for h in hazards
+        )
+        return (
+            f"static analysis flagged this program (RT302): {spots} "
+            f"[wrap at {site}]"
+        )
+    return (
+        f"wrap site {site} has no static RT302 hazard on record — "
+        f"suspect call-site shape drift; run "
+        f"`ray_tpu devtools accel` after reproducing"
+    )

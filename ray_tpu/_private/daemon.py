@@ -5738,16 +5738,27 @@ class NodeDaemon:
             threshold=msg.get("compile_storm_threshold"),
         )
         for storm in compile_verdict.get("storms", ()):
-            problems.append(
-                {
-                    "kind": "recompile_storm",
-                    "program": storm["program"],
-                    "compiles": storm["compiles"],
-                    "distinct_shapes": storm["distinct_shapes"],
-                    "delta": storm["delta"],
-                    "detail": storm["detail"],
-                }
-            )
+            problem = {
+                "kind": "recompile_storm",
+                "program": storm["program"],
+                "compiles": storm["compiles"],
+                "distinct_shapes": storm["distinct_shapes"],
+                "delta": storm["delta"],
+                "detail": storm["detail"],
+            }
+            # Static bridge: resolve the storming program name against
+            # the accel-pass inventory so the verdict names the RT302
+            # source line, not just the symptom. Best-effort — a
+            # missing/odd inventory must never break diagnose.
+            try:
+                from .compile_watch import static_hint
+
+                hint = static_hint(storm["program"])
+            except Exception:  # noqa: BLE001
+                hint = None
+            if hint:
+                problem["static_hint"] = hint
+            problems.append(problem)
         for row in compile_verdict.get("hbm_pressure", ()):
             problems.append(
                 {
@@ -5864,7 +5875,7 @@ class NodeDaemon:
         try:
             own = self._h_lock_witness(conn, {"all_workers": True})
             witness_procs.extend(own.get("procs", [own]))
-        except Exception as e:  # rt: noqa[RT007] — diagnose still replies; the gap is folded into the verdict below, not dropped
+        except Exception as e:  # diagnose still replies; the gap is folded into the verdict below, not dropped
             problems.append(
                 {
                     "kind": "unreachable_node",

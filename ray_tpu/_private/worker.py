@@ -1405,7 +1405,7 @@ class CoreWorker:
             # spec, so an idle or sequential-latency worker never pays
             # the 40 Hz poll.
             if q.empty():
-                evt.wait(5.0)  # rt: noqa[RT008] — deliberate park; enqueue sets the event
+                evt.wait(5.0)  # deliberate park with deadline; enqueue sets the event
                 evt.clear()
             time.sleep(0.025)
             if q.empty() or not self._inflight_tasks:

@@ -1,6 +1,6 @@
 """Developer tooling: static analysis for distributed correctness.
 
-Three layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
+Four layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
 `--json`, exit 0/1/2):
 
 * `ray_tpu lint [paths]` — per-file, syntactic (rules RT001-RT010 in
@@ -18,23 +18,41 @@ Three layers, one suppression/output contract (`# rt: noqa[RTxxx]`,
   lock-order cycles, blocking-under-lock. Its runtime counterpart is
   devtools/lock_witness.py (`RT_lock_witness_enabled`), feeding
   `rt.diagnose()`'s `verdict.locks`.
-* `ray_tpu devtools all [paths]` — all three, merged, as one CI gate.
+* `ray_tpu devtools accel [paths]` — accelerator hot-path analysis
+  (devtools/accel.py, rules RT301-RT306): jit/donate wrap inventory x
+  hot-loop contexts — per-call re-jits, recompile-hazard arguments,
+  hidden host syncs, use-after-donate, dispatch-only timing,
+  compile-watch-invisible programs. Its runtime counterpart is
+  `_private/compile_watch.py` (`rt.diagnose()`'s `verdict.compile`),
+  and `accel.build_inventory()` is the bridge: a live recompile storm
+  resolves its program name to the static RT302 site.
+* `ray_tpu devtools all [paths]` — all four, merged, as one CI gate.
+
+Every pass also audits the suppressions it owns (RT090/RT190/RT290/
+RT390): a `# rt: noqa[RTxxx]` naming a nonexistent rule, or
+suppressing one that never fires on that line, is itself a finding.
 
 Programmatic:
 
-    from ray_tpu.devtools import lint_paths, check_paths, race_paths
+    from ray_tpu.devtools import (
+        lint_paths, check_paths, race_paths, accel_paths,
+    )
     findings = (
         lint_paths(["ray_tpu"])
         + check_paths(["ray_tpu"])
         + race_paths(["ray_tpu"])
+        + accel_paths(["ray_tpu"])
     )
 
 The repo holds itself to all layers in tests/test_lint.py,
-tests/test_check.py and tests/test_concurrency_analysis.py, so every
-new idiom, cross-process contract, or thread/lock interaction either
+tests/test_check.py, tests/test_concurrency_analysis.py and
+tests/test_accel_analysis.py, so every new idiom, cross-process
+contract, thread/lock interaction, or accelerator hot path either
 passes the rules or carries an explicit, reviewable suppression.
 """
 
+from .accel import accel_paths, accel_sources, build_inventory  # noqa: F401
+from .accel import main as accel_main  # noqa: F401
 from .check import check_paths, check_sources  # noqa: F401
 from .check import main as check_main  # noqa: F401
 from .concurrency import race_paths, race_sources  # noqa: F401
@@ -44,8 +62,8 @@ from .rules import ALL_RULES  # noqa: F401
 
 
 def all_main(argv=None, out=None) -> int:
-    """`ray_tpu devtools all [paths] [--json]` — lint + check + race
-    over the same tree with merged findings: the single CI gate.
+    """`ray_tpu devtools all [paths] [--json]` — lint + check + race +
+    accel over the same tree with merged findings: the single CI gate.
     Shares the individual tools' default-path, validation, rendering,
     and exit-code behavior (0 clean, 1 findings, 2 usage errors) so
     the gate can never diverge from running them separately."""
@@ -59,7 +77,8 @@ def all_main(argv=None, out=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu devtools all",
         description=(
-            "lint + check + race with merged findings (single CI gate)"
+            "lint + check + race + accel with merged findings "
+            "(single CI gate)"
         ),
     )
     parser.add_argument(
@@ -85,7 +104,12 @@ def all_main(argv=None, out=None) -> int:
             file=sys.stderr,
         )
         return 2
-    findings = lint_paths(paths) + check_paths(paths) + race_paths(paths)
+    findings = (
+        lint_paths(paths)
+        + check_paths(paths)
+        + race_paths(paths)
+        + accel_paths(paths)
+    )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     if args.as_json:
         print(

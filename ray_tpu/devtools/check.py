@@ -53,7 +53,7 @@ from .contracts import (
     SymbolTable,
     build_symbol_table,
 )
-from .lint import Finding, _dotted, _iter_py_files
+from .lint import Finding, _dotted, _iter_py_files, noqa_hygiene
 
 __all__ = ["check_sources", "check_paths", "main", "RULES"]
 
@@ -65,6 +65,7 @@ RULES: Dict[str, str] = {
     "RT104": "call-site kwargs drift vs wire schema / schema-less handler",
     "RT105": "obviously unserializable value passed to .remote()",
     "RT106": "fire-and-forget .remote(): result ObjectRef is discarded",
+    "RT190": "stale or unknown '# rt: noqa' suppression (check family)",
 }
 
 #: Handler methods invoked by infrastructure rather than literal call
@@ -625,6 +626,20 @@ def check_sources(
         ):
             continue
         kept.append(finding)
+    # Noqa hygiene (RT190) audits the RAW findings and bypasses
+    # suppression — a stale noqa cannot suppress its own report.
+    if only is None or "RT190" in only:
+        for path, source in sources:
+            kept.extend(
+                noqa_hygiene(
+                    path,
+                    source,
+                    findings,
+                    family_digit="1",
+                    known_ids=set(RULES),
+                    hygiene_id="RT190",
+                )
+            )
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return kept
 
@@ -670,8 +685,8 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu check",
         description=(
-            "whole-program contract checker (rules RT101-RT106; "
-            "suppress with '# rt: noqa[RTxxx]')"
+            "whole-program contract checker (rules RT101-RT106 + RT190 "
+            "noqa hygiene; suppress with '# rt: noqa[RTxxx]')"
         ),
     )
     parser.add_argument(

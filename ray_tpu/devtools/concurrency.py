@@ -76,7 +76,13 @@ from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .contracts import build_symbol_table
-from .lint import Finding, _dotted, _is_remote_decorator, _iter_py_files
+from .lint import (
+    Finding,
+    _dotted,
+    _is_remote_decorator,
+    _iter_py_files,
+    noqa_hygiene,
+)
 
 __all__ = ["race_sources", "race_paths", "main", "RULES"]
 
@@ -88,6 +94,7 @@ RULES: Dict[str, str] = {
     "RT204": "Condition.wait() outside a predicate loop",
     "RT205": "per-call lock guards nothing",
     "RT206": "finalizer/__del__ acquires a lock on an arbitrary thread",
+    "RT290": "stale or unknown '# rt: noqa' suppression (race family)",
 }
 
 #: Constructors that create a mutex-like object -> kind.
@@ -1345,6 +1352,20 @@ def race_sources(
         ):
             continue
         kept.append(finding)
+    # Noqa hygiene (RT290) audits the RAW findings and bypasses
+    # suppression — a stale noqa cannot suppress its own report.
+    if only is None or "RT290" in only:
+        for path, source in sources:
+            kept.extend(
+                noqa_hygiene(
+                    path,
+                    source,
+                    findings,
+                    family_digit="2",
+                    known_ids=set(RULES),
+                    hygiene_id="RT290",
+                )
+            )
     # A judgment can be reached via more than one path (lexical +
     # inherited); report each (path, line, rule) once.
     uniq: Dict[Tuple[str, int, str], Finding] = {}
@@ -1396,8 +1417,8 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu devtools race",
         description=(
-            "whole-program concurrency analyzer (rules RT201-RT206; "
-            "suppress with '# rt: noqa[RT2xx]')"
+            "whole-program concurrency analyzer (rules RT201-RT206 + "
+            "RT290 noqa hygiene; suppress with '# rt: noqa[RT2xx]')"
         ),
     )
     parser.add_argument(

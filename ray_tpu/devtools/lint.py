@@ -25,24 +25,41 @@ from __future__ import annotations
 
 import argparse
 import ast
+import io
 import json
 import os
 import re
 import sys
+import tokenize
 from dataclasses import asdict, dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Finding",
     "LintContext",
     "lint_source",
     "lint_paths",
+    "noqa_hygiene",
     "main",
 ]
 
 _NOQA_RE = re.compile(
     r"#\s*rt:\s*noqa(?:\[(?P<rules>[A-Z0-9,\s]+)\])?", re.IGNORECASE
 )
+
+_RULE_ID_RE = re.compile(r"RT\d{3}")
+
+#: Rule-family ownership for noqa hygiene: RT0xx lint, RT1xx check,
+#: RT2xx race, RT3xx accel. Each pass audits only the suppressions it
+#: owns; lint additionally audits ids no family owns.
+_FAMILY_DIGITS = {"0": "lint", "1": "check", "2": "race", "3": "accel"}
+
+#: The per-pass hygiene rule ids themselves — not suppressible (a
+#: stale suppression must not be able to suppress its own report).
+_HYGIENE_IDS = {"RT090", "RT190", "RT290", "RT390"}
+
+#: lint's own hygiene rule (engine-level: not an AST walker rule).
+HYGIENE_RULE = ("RT090", "stale or unknown '# rt: noqa' suppression")
 
 
 @dataclass
@@ -75,6 +92,116 @@ def _parse_noqa(source: str) -> Dict[int, Optional[set]]:
             out[lineno] = {
                 r.strip().upper() for r in rules.split(",") if r.strip()
             }
+    return out
+
+
+def _noqa_comment_rules(source: str) -> Dict[int, Set[str]]:
+    """line -> explicit rule-id set, counting only genuine COMMENT
+    tokens. Unlike `_parse_noqa` (which is a per-line regex so that
+    suppression stays cheap and predictable), hygiene must NOT judge
+    noqa text embedded in string literals — test fixtures build
+    sources containing noqa markers all the time."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _NOQA_RE.search(tok.string)
+            if match is None or match.group("rules") is None:
+                continue
+            out[tok.start[0]] = {
+                r.strip().upper()
+                for r in match.group("rules").split(",")
+                if r.strip()
+            }
+    except (tokenize.TokenError, IndentationError, SyntaxError, ValueError):
+        pass  # unparseable files already get RT000
+    return out
+
+
+def noqa_hygiene(
+    path: str,
+    source: str,
+    raw_findings: Sequence[Finding],
+    family_digit: str,
+    known_ids: Set[str],
+    hygiene_id: str,
+    orphan_families: bool = False,
+) -> List[Finding]:
+    """Audit explicit ``# rt: noqa[RTxxx]`` comments against the RAW
+    (pre-suppression) findings of the owning pass: an id that does not
+    exist, or that never fires on its line, is itself a finding —
+    stale suppressions must not rot silently. Shared by all four
+    passes (lint RT090 / check RT190 / race RT290 / accel RT390);
+    `orphan_families` additionally makes lint the reporter for ids no
+    family owns (RT9xx typos etc.). Bare ``# rt: noqa`` is exempt: it
+    names no claim to audit."""
+    fired: Dict[int, Set[str]] = {}
+    for finding in raw_findings:
+        if finding.path == path:
+            fired.setdefault(finding.line, set()).add(finding.rule)
+    out: List[Finding] = []
+    for line, ids in sorted(_noqa_comment_rules(source).items()):
+        for rid in sorted(ids):
+            if rid in _HYGIENE_IDS:
+                if rid[2] == family_digit:
+                    out.append(
+                        Finding(
+                            path=path, line=line, col=1, rule=hygiene_id,
+                            message=(
+                                f"'{rid}' is the noqa-hygiene rule itself "
+                                f"and cannot be suppressed — remove it and "
+                                f"fix the stale suppression it reports"
+                            ),
+                        )
+                    )
+                continue
+            if _RULE_ID_RE.fullmatch(rid) is None:
+                if orphan_families:
+                    out.append(
+                        Finding(
+                            path=path, line=line, col=1, rule=hygiene_id,
+                            message=(
+                                f"noqa names malformed rule id '{rid}' "
+                                f"(expected RTxyz)"
+                            ),
+                        )
+                    )
+                continue
+            digit = rid[2]
+            if digit == family_digit:
+                if rid not in known_ids:
+                    out.append(
+                        Finding(
+                            path=path, line=line, col=1, rule=hygiene_id,
+                            message=(
+                                f"noqa names unknown rule id {rid} — no "
+                                f"such rule in the "
+                                f"{_FAMILY_DIGITS[digit]} family"
+                            ),
+                        )
+                    )
+                elif rid not in fired.get(line, ()):
+                    out.append(
+                        Finding(
+                            path=path, line=line, col=1, rule=hygiene_id,
+                            message=(
+                                f"noqa suppresses {rid}, which does not "
+                                f"fire on this line — stale suppression; "
+                                f"remove it"
+                            ),
+                        )
+                    )
+            elif orphan_families and digit not in _FAMILY_DIGITS:
+                out.append(
+                    Finding(
+                        path=path, line=line, col=1, rule=hygiene_id,
+                        message=(
+                            f"noqa names unknown rule id {rid} — no "
+                            f"devtools family owns RT{digit}xx"
+                        ),
+                    )
+                )
     return out
 
 
@@ -214,16 +341,16 @@ def _rules_for(path: str, rules: Sequence) -> List:
     return [r for r in rules if r.in_scope(norm)]
 
 
-def _active_rules(only: Optional[Iterable[str]] = None) -> List:
+def _wanted_ids(only: Optional[Iterable[str]]) -> Optional[Set[str]]:
+    if only is None:
+        return None
     from .rules import ALL_RULES
 
-    if only is None:
-        return list(ALL_RULES)
     wanted = {r.upper() for r in only}
-    unknown = wanted - {r.id for r in ALL_RULES}
+    unknown = wanted - ({r.id for r in ALL_RULES} | {HYGIENE_RULE[0]})
     if unknown:
         raise ValueError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
-    return [r for r in ALL_RULES if r.id in wanted]
+    return wanted
 
 
 def lint_source(
@@ -232,9 +359,12 @@ def lint_source(
     rules: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
     """Lint one source blob; `path` drives per-rule scoping."""
-    active = _rules_for(path, _active_rules(rules))
-    if not active:
-        return []
+    wanted = _wanted_ids(rules)
+    from .rules import ALL_RULES
+
+    # Always walk with every in-scope rule: noqa hygiene judges the
+    # RAW findings, so staleness cannot depend on the --rules filter.
+    active = _rules_for(path, list(ALL_RULES))
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -249,16 +379,32 @@ def lint_source(
         ]
     ctx = LintContext(path, tree)
     sink: List[Finding] = []
-    _Walker(ctx, active, sink).visit(tree)
+    if active:
+        _Walker(ctx, active, sink).visit(tree)
     noqa = _parse_noqa(source)
     kept = []
     for finding in sink:
+        if wanted is not None and finding.rule not in wanted:
+            continue
         suppressed = noqa.get(finding.line)
         if finding.line in noqa and (
             suppressed is None or finding.rule in suppressed
         ):
             continue
         kept.append(finding)
+    if wanted is None or HYGIENE_RULE[0] in wanted:
+        known = {r.id for r in ALL_RULES} | {"RT000"}
+        kept.extend(
+            noqa_hygiene(
+                path,
+                source,
+                sink,
+                family_digit="0",
+                known_ids=known,
+                hygiene_id=HYGIENE_RULE[0],
+                orphan_families=True,
+            )
+        )
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return kept
 
@@ -312,7 +458,8 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         prog="ray_tpu lint",
         description=(
             "framework-aware distributed-correctness linter "
-            "(rules RT001-RT010; suppress with '# rt: noqa[RTxxx]')"
+            "(rules RT001-RT010 + RT090 noqa hygiene; suppress with "
+            "'# rt: noqa[RTxxx]')"
         ),
     )
     parser.add_argument(
@@ -347,6 +494,7 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     if args.list_rules:
         for rule in ALL_RULES:
             print(f"{rule.id}  {rule.title}", file=out)
+        print(f"{HYGIENE_RULE[0]}  {HYGIENE_RULE[1]}", file=out)
         return 0
     if not args.paths:
         # Default to the package this CLI shipped in — NOT a

@@ -1071,7 +1071,11 @@ class InferenceEngine:
             base_logits = self._last_logits
             merged = base_logits
             pool = self._kv.pool
-            tokens = np.zeros(ec.slots, np.int64)
+            # Tokens merge on-device too: a per-group np.asarray here
+            # would block the host once per generation inside the hot
+            # step loop (static analyzer rule RT303); one sync after
+            # the loop costs the same D2H as the single-gen path.
+            merged_tokens = None
             for gen in sorted(by_gen):
                 mask = np.zeros(ec.slots, bool)
                 mask[by_gen[gen]] = True
@@ -1091,10 +1095,14 @@ class InferenceEngine:
                 merged = jnp.where(
                     gmask[:, None], out_logits, merged
                 )
-                group_tokens = np.asarray(token)
-                tokens[mask] = group_tokens[mask]
+                merged_tokens = jnp.where(
+                    gmask,
+                    token,
+                    0 if merged_tokens is None else merged_tokens,
+                )
             self._kv.pool = pool
             self._last_logits = merged
+            tokens = np.asarray(merged_tokens)  # ONE sync for the window
         step_ms = (time.perf_counter() - t0) * 1e3
         self._steps += 1
         now = time.perf_counter()

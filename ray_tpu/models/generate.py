@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .._private import compile_watch
 from ..ops.norms import apply_rotary, rotary_embedding
 from .llama import embed_tokens, model_glu, model_norm
 from .llama import LlamaConfig, project_qkv
@@ -235,11 +236,14 @@ def decode_step(
     the returned values."""
     global _decode_step_jit
     if _decode_step_jit is None:
-        _decode_step_jit = partial(
-            jax.jit,
-            static_argnames=("temperature", "top_k", "cfg"),
-            donate_argnums=accel_donate(2, 3),
-        )(_decode_step)
+        _decode_step_jit = compile_watch.instrument(
+            "generate.decode_step",
+            partial(
+                jax.jit,
+                static_argnames=("temperature", "top_k", "cfg"),
+                donate_argnums=accel_donate(2, 3),
+            )(_decode_step),
+        )
     return _decode_step_jit(
         params, cfg, cache, last_logits, positions, alive, key,
         temperature=temperature, top_k=top_k,
@@ -257,11 +261,14 @@ def prefill(params, cfg: LlamaConfig, tokens, cache, cache_pos, valid_len):
     cache."""
     global _prefill_jit
     if _prefill_jit is None:
-        _prefill_jit = partial(
-            jax.jit,
-            static_argnames=("cfg",),
-            donate_argnums=accel_donate(3),
-        )(_forward_with_cache)
+        _prefill_jit = compile_watch.instrument(
+            "generate.prefill",
+            partial(
+                jax.jit,
+                static_argnames=("cfg",),
+                donate_argnums=accel_donate(3),
+            )(_forward_with_cache),
+        )
     return _prefill_jit(
         params, cfg, tokens, cache, cache_pos, valid_len
     )
@@ -431,11 +438,14 @@ def paged_prefill(
     `pool` is donated on accelerator backends."""
     global _paged_prefill_jit
     if _paged_prefill_jit is None:
-        _paged_prefill_jit = partial(
-            jax.jit,
-            static_argnames=("cfg",),
-            donate_argnums=accel_donate(3),
-        )(_paged_prefill_impl)
+        _paged_prefill_jit = compile_watch.instrument(
+            "generate.paged_prefill",
+            partial(
+                jax.jit,
+                static_argnames=("cfg",),
+                donate_argnums=accel_donate(3),
+            )(_paged_prefill_impl),
+        )
     return _paged_prefill_jit(
         params, cfg, tokens, pool, table, offset, valid_len
     )
@@ -495,11 +505,14 @@ def paged_decode_step(
     consumed."""
     global _paged_decode_jit
     if _paged_decode_jit is None:
-        _paged_decode_jit = partial(
-            jax.jit,
-            static_argnames=("temperature", "top_k", "cfg"),
-            donate_argnums=accel_donate(2, 4),
-        )(_paged_decode_step_impl)
+        _paged_decode_jit = compile_watch.instrument(
+            "generate.paged_decode_step",
+            partial(
+                jax.jit,
+                static_argnames=("temperature", "top_k", "cfg"),
+                donate_argnums=accel_donate(2, 4),
+            )(_paged_decode_step_impl),
+        )
     return _paged_decode_jit(
         params, cfg, pool, tables, last_logits, positions, alive, key,
         temperature=temperature, top_k=top_k,
@@ -580,6 +593,13 @@ def generate(
     return tokens, lengths
 
 
+# Rebind through the compile watch so whole-batch generation shows up
+# in `rt.diagnose()`'s verdict.compile by name instead of as
+# "(unregistered)". Module-level rebinding keeps the name importable
+# and picklable by reference.
+generate = compile_watch.instrument("generate.generate", generate)
+
+
 def generate_stream(
     params: Dict[str, Any],
     prompt_tokens: jax.Array,
@@ -650,9 +670,9 @@ def generate_stream(
             temperature=temperature, top_k=top_k,
         )
         alive = alive & (token != eos_token)
-        yield np.asarray(token)  # device->host sync per step
+        yield np.asarray(token)  # rt: noqa[RT303] — the stream contract IS one host token per step; this sync is the product, not overhead
         position = position + 1
         # Post-step mask: once every row has emitted EOS there is no
         # token left to produce — stop without dispatching a dead step.
-        if not np.asarray(alive).any():
+        if not np.asarray(alive).any():  # rt: noqa[RT303] — early-stop predicate must reach the host; it saves whole dead dispatches, worth one scalar sync
             return

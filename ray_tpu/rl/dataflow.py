@@ -92,7 +92,11 @@ class PolicyProgram:
                 "values": values,
             }
 
-        self._jit = jax.jit(_run)
+        from .._private import compile_watch
+
+        self._jit = compile_watch.instrument(
+            "rl.policy_program", jax.jit(_run)
+        )
 
     def bucket_for(self, rows: int) -> int:
         for b in self.buckets:

@@ -115,8 +115,14 @@ class DQNLearner:
             buffer_capacity, obs_size, seed=seed
         )
         self.updates = 0
-        self._update_jit = jax.jit(self._td_update)
-        self._q_jit = jax.jit(self._q_values)
+        from .._private import compile_watch
+
+        self._update_jit = compile_watch.instrument(
+            "rl.dqn.td_update", jax.jit(self._td_update)
+        )
+        self._q_jit = compile_watch.instrument(
+            "rl.dqn.q_values", jax.jit(self._q_values)
+        )
 
     # -- Q function ----------------------------------------------------
     @staticmethod

@@ -54,18 +54,27 @@ class JaxLearner:
         self.minibatch_size = minibatch_size
         self.num_epochs = num_epochs
         self._rng = np.random.default_rng(seed)
-        self._update_jit = jax.jit(self._minibatch_update)
+        from .._private import compile_watch
+
+        self._update_jit = compile_watch.instrument(
+            "rl.ppo.minibatch_update", jax.jit(self._minibatch_update)
+        )
         # Split-phase entry points for the multi-learner path
         # (learner_group.py): gradients computed per shard, applied
         # identically everywhere after averaging (reference:
         # learner.py compute_gradients/apply_gradients split,
         # torch_learner.py:171,192).
-        self._grad_jit = jax.jit(
-            lambda params, batch: jax.value_and_grad(
-                self._loss, has_aux=True
-            )(params, batch)
+        self._grad_jit = compile_watch.instrument(
+            "rl.ppo.compute_gradients",
+            jax.jit(
+                lambda params, batch: jax.value_and_grad(
+                    self._loss, has_aux=True
+                )(params, batch)
+            ),
         )
-        self._apply_jit = jax.jit(self._apply_gradients)
+        self._apply_jit = compile_watch.instrument(
+            "rl.ppo.apply_gradients", jax.jit(self._apply_gradients)
+        )
 
     # -- PPO loss (reference: ppo_torch_learner compute_loss) ---------
     def _loss(self, params, batch):

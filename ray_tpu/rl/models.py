@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .._private import compile_watch
+
 
 def init_policy_params(
     key, obs_size: int, num_actions: int, hidden: Tuple[int, ...] = (64, 64)
@@ -61,6 +63,9 @@ def _sample_jit(params, obs, key):
         jnp.arange(logits.shape[0]), actions
     ]
     return actions, logp, value, key
+
+
+_sample_jit = compile_watch.instrument("rl.sample_actions", _sample_jit)
 
 
 def sample_actions(params: Dict, obs: np.ndarray, key):

@@ -826,10 +826,30 @@ def cmd_race(args) -> None:
     sys.exit(race_main(argv))
 
 
+def cmd_accel(args) -> None:
+    """`ray_tpu devtools accel [paths]` — accelerator hot-path
+    analysis (devtools/accel.py, rules RT301-RT306). Offline: builds
+    the jit/donate wrap inventory over the tree and judges hot-loop
+    usage; `--inventory` emits the machine-readable program inventory
+    the compile watch's static_hint() bridge consumes."""
+    from ..devtools.accel import main as accel_main
+
+    argv = list(args.paths or [])
+    if args.as_json:
+        argv.append("--json")
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.inventory:
+        argv.append("--inventory")
+    sys.exit(accel_main(argv))
+
+
 def cmd_devtools_all(args) -> None:
-    """`ray_tpu devtools all [paths]` — lint + check + race as one CI
-    gate with merged findings (devtools.all_main; JSON mode emits one
-    combined list)."""
+    """`ray_tpu devtools all [paths]` — lint + check + race + accel
+    as one CI gate with merged findings (devtools.all_main; JSON mode
+    emits one combined list)."""
     from ..devtools import all_main
 
     argv = list(args.paths or [])
@@ -1167,7 +1187,7 @@ def main(argv=None) -> None:
     p_all = devtools_sub.add_parser(
         "all",
         help=(
-            "run lint + check + race with merged findings "
+            "run lint + check + race + accel with merged findings "
             "(single CI gate)"
         ),
     )
@@ -1204,6 +1224,38 @@ def main(argv=None) -> None:
         help="print the rule table and exit",
     )
     p_race.set_defaults(fn=cmd_race)
+
+    p_accel = devtools_sub.add_parser(
+        "accel",
+        help=(
+            "accelerator hot-path analysis "
+            "(rules RT301-RT306)"
+        ),
+    )
+    p_accel.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to analyze as one program (default: ray_tpu)",
+    )
+    p_accel.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON (CI mode)",
+    )
+    p_accel.add_argument(
+        "--rules", help="comma-separated rule ids to run"
+    )
+    p_accel.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    p_accel.add_argument(
+        "--inventory", action="store_true",
+        help=(
+            "emit the machine-readable jit-program inventory "
+            "(the doctor's static_hint bridge input) instead of findings"
+        ),
+    )
+    p_accel.set_defaults(fn=cmd_accel)
 
     p_dash = sub.add_parser(
         "dashboard", help="serve the dashboard for a running cluster"

@@ -193,15 +193,21 @@ def make_pp_train_step(
     )
 
     def init_fn(key, init_params_fn) -> TrainState:
+        from .._private import compile_watch
+
         def build(k):
             return to_pipeline_params(init_params_fn(k), pp)
 
-        params = jax.jit(build, out_shardings=param_shardings)(key)
+        params = compile_watch.instrument(
+            "train.pipeline.init_params",
+            jax.jit(build, out_shardings=param_shardings),
+        )(key)
         opt_shardings = infer_opt_shardings(
             optimizer, params, param_shardings, repl
         )
-        opt_state = jax.jit(
-            optimizer.init, out_shardings=opt_shardings
+        opt_state = compile_watch.instrument(
+            "train.pipeline.init_opt_state",
+            jax.jit(optimizer.init, out_shardings=opt_shardings),
         )(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params,

@@ -132,10 +132,13 @@ def make_train_step(
     repl = NamedSharding(mesh, P())
 
     def init_fn(key, init_params_fn) -> TrainState:
+        from .._private import compile_watch
+
         # jit with out_shardings lays parameters out directly on the
         # mesh — no host-side full copy of the model is ever built.
-        params = jax.jit(
-            init_params_fn, out_shardings=param_shardings
+        params = compile_watch.instrument(
+            "train.init_params",
+            jax.jit(init_params_fn, out_shardings=param_shardings),
         )(key)
         # Optimizer moments must shard exactly like their parameters
         # (the ZeRO-3 property); jit's inference doesn't guarantee it,
@@ -143,8 +146,9 @@ def make_train_step(
         opt_shardings = infer_opt_shardings(
             optimizer, params, param_shardings, repl
         )
-        opt_state = jax.jit(
-            optimizer.init, out_shardings=opt_shardings
+        opt_state = compile_watch.instrument(
+            "train.init_opt_state",
+            jax.jit(optimizer.init, out_shardings=opt_shardings),
         )(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state

@@ -169,7 +169,7 @@ def _drifting_loop(n: int = 12):
     from ray_tpu._private import compile_watch as cw
 
     fn = cw.instrument(
-        "test.drifting_step", jax.jit(lambda x: (x * 2 + 1).sum())
+        "test.drifting_step", jax.jit(lambda x: (x * 2 + 1).sum())  # rt: noqa[RT301] — fixture exists to provoke recompiles on purpose
     )
     for i in range(2, n + 2):
         fn(jnp.asarray(np.zeros((4, i), np.float32)))

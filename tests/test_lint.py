@@ -373,15 +373,20 @@ def test_noqa_suppressions():
     # targeted suppression
     ok = bad + "  # rt: noqa[RT004]"
     assert lint_source(ok, path) == []
-    # suppression for a DIFFERENT rule does not apply
+    # suppression for a DIFFERENT rule does not apply — and the
+    # useless suppression is itself reported (noqa hygiene, RT090).
     wrong = bad + "  # rt: noqa[RT001]"
-    assert {f.rule for f in lint_source(wrong, path)} == {"RT004"}
+    assert {f.rule for f in lint_source(wrong, path)} == {
+        "RT004",
+        "RT090",
+    }
     # blanket suppression
     blanket = bad + "  # rt: noqa"
     assert lint_source(blanket, path) == []
-    # multi-rule form
+    # multi-rule form: RT004 is suppressed, but naming RT001 — which
+    # never fires on that line — is a stale suppression.
     multi = bad + "  # rt: noqa[RT001,RT004]"
-    assert lint_source(multi, path) == []
+    assert {f.rule for f in lint_source(multi, path)} == {"RT090"}
 
 
 def test_json_output_mode(tmp_path):
