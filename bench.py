@@ -1496,6 +1496,27 @@ def run_micro() -> dict:
             lambda: rt.get(rt.put(small), timeout=30), 200
         )
 
+        # 7b. get-provenance instrument (ISSUE 20): the classify+fold
+        # every rt.get resolution pays — provenance-key fold under the
+        # stats lock plus drain-hook arming (phase billing gates out
+        # here: no task context on the bench driver, exactly like any
+        # driver get). Held under 1% of a --smoke step by
+        # tests/test_data_plane.py.
+        from ray_tpu._private.worker import global_worker as _gp_gw
+
+        _gp_worker = _gp_gw()
+
+        def _gp_trial() -> float:
+            n = 5000
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _gp_worker._record_get("local", "", 4096, 0.05)
+            return (time.perf_counter() - t0) / n * 1e6
+
+        results["get_provenance_overhead_us"] = _micro_case_from(
+            _gp_trial, digits=3
+        )
+
         # warm the worker pool for the throughput cases
         rt.get([nop.remote() for _ in range(8)], timeout=60)
 

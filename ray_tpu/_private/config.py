@@ -195,6 +195,27 @@ class Config:
     #: seconds after its creation whose owner process died (or whose
     #: job ended) is named a leak suspect.
     doctor_leak_age_s: float = 300.0
+    #: Data-plane provenance reporting (ISSUE 20): each worker
+    #: classifies every rt.get resolution (inline / local / pull /
+    #: restore_local / restore_remote), aggregates per (provenance,
+    #: src node, task class), and drains the aggregates onto the
+    #: metrics pipe at most once per this interval (riding the pipe's
+    #: flush tick — batched like step records, NEVER one RPC per get);
+    #: daemons report pull/restore transfer records the same way. The
+    #: head folds both into the memory ledger's transfer matrix
+    #: (`transfer_summary`, /api/transfers, `ray_tpu memory
+    #: --transfers`, rt_object_transfer_* series). 0 disables the
+    #: whole data-plane instrument (kill switch: workers record
+    #: nothing, daemons report nothing — the flight-recorder
+    #: contract).
+    transfer_report_interval_s: float = 0.5
+    #: `verdict.data` misplacement conviction bar: a task class whose
+    #: gets pulled at least this FRACTION of their bytes from remote
+    #: nodes (and at least 1 MB absolute) while a copy-holding node
+    #: had capacity is named a misplaced-task suspect. Raise it to
+    #: quiet the verdict on broadcast-heavy workloads whose pulls are
+    #: inherent, not placement error.
+    doctor_locality_miss_threshold: float = 0.5
     #: Runtime lock-order witness (devtools/lock_witness.py): wraps
     #: the hot-path locks created through `make_lock` so the process
     #: records its ACTUAL lock-acquisition-order graph plus

@@ -41,7 +41,7 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from .config import Config
 from .gcs import (
@@ -123,6 +123,18 @@ class ObjectEntry:
     owner_pid: int = 0
     #: Wall time of the first seal (leak-age anchor).
     created_ts: float = 0.0
+    #: How THIS node's shm copy materialised: "" (sealed locally),
+    #: "pull" (remote arena), "pull_spill" (remote spill file) or
+    #: "restore" (this node's own spill file). Drives get-path
+    #: provenance classification in worker replies.
+    source: str = ""
+    #: Hex node id the copy was pulled from ("" unless source is a
+    #: pull kind).
+    src_node: str = ""
+    #: True only between the materialising event and its waiter wake:
+    #: gets that actually waited on the pull/restore bill it; later
+    #: gets of the (now warm) copy classify as local arena hits.
+    source_fresh: bool = False
 
 
 @dataclass
@@ -415,6 +427,11 @@ class NodeDaemon:
             max_owner_series=config.memory_report_topk
         )
         self._memory_folded_at = 0.0
+        # Per-job spill/restore OP counts on THIS node (cumulative;
+        # ride the node memory report so the head's ledger attributes
+        # rt_object_spills/restores_total to the job that forced them).
+        self._job_spill_ops: Dict[str, int] = {}
+        self._job_restore_ops: Dict[str, int] = {}
         # This process's flight recorder obeys the cluster config
         # (env RT_flight_recorder_enabled already applied at import).
         from .compile_watch import configure as _compile_configure
@@ -524,6 +541,10 @@ class NodeDaemon:
             # serves `ray_tpu memory` and /api/memory)
             "memory_report",
             "memory_summary",
+            # data plane (ISSUE 20): the transfer matrix and the
+            # object location/size index
+            "transfer_summary",
+            "object_locations",
             "event_stats",
             "profile_worker",
             # XLA observability: coordinated gang profiling + the
@@ -1390,7 +1411,16 @@ class NodeDaemon:
             if entry.inline is not None:
                 return {"inline": entry.inline}
             if entry.in_shm:
-                return {"shm_size": entry.size}
+                reply = {"shm_size": entry.size}
+                if entry.source_fresh and entry.source:
+                    # Provenance rides the reply only while fresh so
+                    # the worker can classify this get's wait; the
+                    # flag clears once the materialising event's
+                    # waiters have been answered.
+                    reply["via"] = entry.source
+                    if entry.src_node:
+                        reply["src"] = entry.src_node
+                return reply
         return None  # sealed, data elsewhere
 
     def _wake(self, oid: ObjectID) -> None:
@@ -1469,7 +1499,12 @@ class NodeDaemon:
                     elif entry.inline is not None:
                         out.append({"inline": entry.inline})
                     elif entry.in_shm:
-                        out.append({"shm_size": entry.size})
+                        reply = {"shm_size": entry.size}
+                        if entry.source_fresh and entry.source:
+                            reply["via"] = entry.source
+                            if entry.src_node:
+                                reply["src"] = entry.src_node
+                        out.append(reply)
                     else:
                         pulls.append(oid)
                         out.append({"pending": True})
@@ -1575,7 +1610,13 @@ class NodeDaemon:
             data = self.spill.read(oid, offset, length)
             total = self.spill.size(oid)
             if data is not None and total is not None:
-                return {"data": _oob_chunk(data), "total_size": total}
+                # Marker lets the puller classify the transfer as a
+                # remote spill restore rather than an arena pull.
+                return {
+                    "data": _oob_chunk(data),
+                    "total_size": total,
+                    "from_spill": True,
+                }
         return {"missing": True}
 
     def _h_delete_object(self, conn, msg):
@@ -2064,16 +2105,60 @@ class NodeDaemon:
                 return False
             entry.spilled = True
             entry.in_shm = False
+            job = entry.owner_job
         self._unpin_primary(oid)
         self.store.unlink_by_id(oid)
         self.core_counters.bump("spills")
+        self._bump_job_op(self._job_spill_ops, job)
         return True
+
+    def _bump_job_op(self, table: Dict[str, int], job: str) -> None:
+        """Count one spill/restore op against a job on THIS node
+        (""-keyed when unattributed); cumulative, shipped with the
+        node memory report."""
+        with self._lock:
+            table[job] = table.get(job, 0) + 1
+
+    def _report_transfer(
+        self, job: str, src: str, kind: str, nbytes: int, ms: float
+    ) -> None:
+        """Bill one completed (or aborted) data movement INTO this
+        node against the (job, src, dst) flow. Rides the metrics pipe
+        like step records — the head folds its own directly, a worker
+        node piggybacks one notify per pull/restore OP (never one per
+        get; gets aggregate worker-side)."""
+        if self.config.transfer_report_interval_s <= 0:
+            return
+        rec = (
+            "transfer",
+            kind,
+            float(nbytes),
+            (
+                ("dst", self.node_id.hex()),
+                ("job", job or ""),
+                ("ms", str(round(ms, 3))),
+                ("src", src),
+            ),
+        )
+        if self.is_head:
+            with self._lock:
+                self._apply_metric_record(rec)
+        elif self.head is not None:
+            try:
+                # No (sender, seq): a lost notify costs one record,
+                # not a double-count — transfer ops are rare enough
+                # (per pull, not per get) that dedup bookkeeping
+                # isn't worth a synchronous call on the pull path.
+                self.head.notify("metrics_record", records=[rec])
+            except Exception:
+                pass
 
     def _restore_spilled(self, oid: ObjectID) -> bool:
         """Copy a spilled object back into the shm store so local
         consumers map it zero-copy again."""
         if self.spill is None:
             return False
+        t0 = time.perf_counter()
         data = self.spill.read(oid)
         if data is None:
             return False
@@ -2107,10 +2192,21 @@ class NodeDaemon:
             entry.in_shm = True
             entry.size = len(data)
             entry.state = SEALED
+            # Provenance: waiters woken by this restore classify their
+            # wait as a spill restore, not an arena hit.
+            entry.source = "restore"
+            entry.src_node = ""
+            entry.source_fresh = True
+            job = entry.owner_job
             if self.is_head:
                 entry.locations.add(self.node_id.binary())
         self._pin_primary(oid, len(data), pin=pin)
         self.core_counters.bump("restores")
+        self._bump_job_op(self._job_restore_ops, job)
+        self._report_transfer(
+            job, self.node_id.hex(), "restore", len(data),
+            (time.perf_counter() - t0) * 1000.0,
+        )
         return True
 
     # -- cross-node pull -------------------------------------------------
@@ -2138,6 +2234,12 @@ class NodeDaemon:
                 if entry is not None:
                     entry.pulling = False
             self._wake(oid)
+            # Waiters answered: later gets of the (now warm) copy are
+            # plain local arena hits, not pull/restore waits.
+            with self._lock:
+                entry = self.objects.get(oid)
+                if entry is not None:
+                    entry.source_fresh = False
             self._schedule()
 
     def _pull_once(self, oid: ObjectID) -> None:
@@ -2209,6 +2311,8 @@ class NodeDaemon:
             import random as _random
 
             nid, addr = _random.choice(locations)
+            t0 = time.perf_counter()
+            from_spill = False
             if self._pull_same_host(nid, oid, size):
                 pulled = True
             else:
@@ -2218,19 +2322,49 @@ class NodeDaemon:
                 )
                 if client is None:
                     continue
-                pulled = self._pull_chunks(client, oid, size)
+                pulled, from_spill = self._pull_chunks(
+                    client, oid, size
+                )
+            pull_ms = (time.perf_counter() - t0) * 1000.0
+            src_hex = NodeID(nid).hex()
+            if not pulled:
+                # The aborted attempt is COUNTED against the flow but
+                # its bytes are never billed as transferred (the
+                # ledger's "aborted" kind only bumps the op count) —
+                # a retry that succeeds bills the full size exactly
+                # once.
+                self._report_transfer(
+                    meta.get("owner_job", ""), src_hex, "aborted",
+                    size, pull_ms,
+                )
             if pulled:
+                # from_spill is None when no bytes actually moved (a
+                # concurrent pull won the race) — the winner already
+                # billed the transfer and stamped provenance.
+                kind = "pull_spill" if from_spill else "pull"
                 with self._lock:
                     entry = self._ensure_entry(oid)
                     entry.in_shm = True
                     entry.size = size
                     entry.state = SEALED
+                    if from_spill is not None:
+                        # Provenance for waiters: a spill-served pull
+                        # is a remote restore, an arena-served one a
+                        # plain pull.
+                        entry.source = kind
+                        entry.src_node = src_hex
+                        entry.source_fresh = True
                     # The secondary copy fills THIS node's arena: carry
                     # the owner from the meta so the memory ledger can
                     # attribute the bytes here too.
                     self._record_owner(entry, meta, local_pid=False)
                     if self.is_head:
                         entry.locations.add(self.node_id.binary())
+                if from_spill is not None:
+                    self._report_transfer(
+                        meta.get("owner_job", ""), src_hex, kind,
+                        size, pull_ms,
+                    )
                 if not self.is_head:
                     try:
                         self.head.call(
@@ -2293,21 +2427,28 @@ class NodeDaemon:
         finally:
             pin.release()
 
-    def _pull_chunks(self, client: RpcClient, oid: ObjectID, size: int) -> bool:
+    def _pull_chunks(
+        self, client: RpcClient, oid: ObjectID, size: int
+    ) -> Tuple[bool, Optional[bool]]:
         """Transfer one object with a WINDOW of chunk requests in
         flight (reference: PushManager streams chunks concurrently
         under an in-flight cap, push_manager.h). The serial
         request-per-chunk loop this replaces was latency-bound: a
-        cross-node 1 GiB transfer paid one RTT per 5 MiB."""
+        cross-node 1 GiB transfer paid one RTT per 5 MiB.
+
+        Returns ``(ok, from_spill)``; ``from_spill`` is True when the
+        source served the bytes from its spill file rather than its
+        arena, and None when no bytes moved at all (already local or a
+        concurrent pull won) so the caller must not bill a transfer."""
         if self.store.contains(oid):
-            return True
+            return True, None
         chunk_size = self.config.object_transfer_chunk_size
         try:
             buf = self.store.create(oid, size)
         except ValueError:
-            return True  # concurrent pull won
+            return True, None  # concurrent pull won
         except Exception:
-            return False
+            return False, None
         self.core_counters.bump("pulls")
         self.core_counters.bump(
             "pull_chunks", max(1, -(-size // chunk_size))
@@ -2321,7 +2462,7 @@ class NodeDaemon:
         done = threading.Event()
         state = {
             "next": 0, "inflight": 0, "completed": 0,
-            "err": None, "aborted": False,
+            "err": None, "aborted": False, "from_spill": False,
         }
 
         def plan_launches_locked() -> list:
@@ -2355,6 +2496,8 @@ class NodeDaemon:
                 planned = []
                 with lock:
                     state["inflight"] -= 1
+                    if reply.get("from_spill"):
+                        state["from_spill"] = True
                     if state["aborted"]:
                         pass  # buffer may already be gone; drop it
                     elif state["err"] is None:
@@ -2409,9 +2552,13 @@ class NodeDaemon:
             with lock:
                 state["aborted"] = True
             self.store.delete(oid)
-            return False
+            # Mid-flight death of the source (or eviction under it) is
+            # counted distinctly; the caller reports the flow-level
+            # "aborted" record (bytes never billed as transferred).
+            self.core_counters.bump("pulls_aborted")
+            return False, state["from_spill"]
         self.store.seal(oid)
-        return True
+        return True, state["from_spill"]
 
     # -- wait ------------------------------------------------------------
     def _h_wait_objects(self, conn, msg):
@@ -4654,6 +4801,33 @@ class NodeDaemon:
                     ),
                     "spilled": entry.spilled,
                     "pinned": pinned,
+                    # Data-plane columns (ISSUE 20): where the bytes
+                    # live, how many copies exist, and how THIS node's
+                    # copy materialised ("" = sealed in place).
+                    "node": (
+                        min(NodeID(n).hex() for n in locations)
+                        if locations
+                        else (
+                            self.node_id.hex()
+                            if entry.in_shm or entry.spilled
+                            else ""
+                        )
+                    ),
+                    "copies": (
+                        len(locations)
+                        if locations
+                        else int(
+                            entry.in_shm
+                            or entry.spilled
+                            or entry.inline is not None
+                        )
+                    ),
+                    "source": (
+                        "inline"
+                        if entry.inline is not None
+                        else entry.source or
+                        ("local" if entry.state == SEALED else "")
+                    ),
                 }
             )
         return {"objects": out}
@@ -4879,6 +5053,41 @@ class NodeDaemon:
             fold_record(
                 self._compile_programs, str(name), float(value), info
             )
+            return
+        if kind == "transfer":
+            # One completed (or aborted) cross-store data movement,
+            # reported by the RECEIVING daemon: `name` is the kind
+            # (pull / pull_spill / restore / aborted), `value` the
+            # byte count, tags carry (dst, job, ms, src). Folded into
+            # the ledger's (job, src, dst) transfer matrix.
+            if self.config.memory_report_interval_s > 0:
+                info = {str(k): v for k, v in tags}
+                self._memory_ledger.record_transfer(
+                    info.get("job", ""),
+                    str(info.get("src", "")),
+                    str(info.get("dst", "")),
+                    str(name),
+                    int(value),
+                    ms=float(info.get("ms", 0.0) or 0.0),
+                )
+            return
+        if kind == "get":
+            # Worker-side rt.get provenance aggregates (one record per
+            # (provenance, src, task) per flush tick — NEVER per get):
+            # `name` is the provenance class, `value` the get count,
+            # tags carry (bytes, job, ms, node, src, task).
+            if self.config.memory_report_interval_s > 0:
+                info = {str(k): v for k, v in tags}
+                self._memory_ledger.record_gets(
+                    info.get("job", ""),
+                    str(name),
+                    str(info.get("src", "")),
+                    str(info.get("node", "")),
+                    str(info.get("task", "")),
+                    int(value),
+                    int(float(info.get("bytes", 0) or 0)),
+                    ms=float(info.get("ms", 0.0) or 0.0),
+                )
             return
         declared = tuple(rec[4]) if len(rec) > 4 else ()
         tags = tuple(tuple(t) for t in tags)
@@ -5232,11 +5441,22 @@ class NodeDaemon:
                 total = sum(values.values())
             entry["total" if kind == "counter" else "value"] = total
             out[name] = entry
-        # Memory-ledger series (rt_job_*, rt_object_owner_*): shaped
-        # like table entries so the Prometheus exposition and the
-        # time-series snapshot loop pick them up without new plumbing.
+        # Memory-ledger series (rt_job_*, rt_object_owner_*, the
+        # transfer matrix): shaped like table entries so the
+        # Prometheus exposition and the time-series snapshot loop pick
+        # them up without new plumbing. MERGED, not replaced: the
+        # ledger's per-job spill/restore tag series must join the core
+        # per-node rt_object_spills/restores_total entries already in
+        # `out`, not clobber them.
         self._refresh_memory_ledger()
-        out.update(self._memory_ledger.metric_entries())
+        for name, entry in self._memory_ledger.metric_entries().items():
+            existing = out.get(name)
+            if existing is None:
+                out[name] = entry
+            else:
+                existing.setdefault("by_tags", {}).update(
+                    entry.get("by_tags", {})
+                )
         return {"metrics": out}
 
     def _timeseries_loop(self) -> None:
@@ -5345,6 +5565,9 @@ class NodeDaemon:
                 if e.in_shm or e.spilled
             ]
         counters = self.core_counters
+        with self._lock:
+            job_spill_ops = dict(self._job_spill_ops)
+            job_restore_ops = dict(self._job_restore_ops)
         return build_node_report(
             self.node_id.hex(),
             entries,
@@ -5352,6 +5575,8 @@ class NodeDaemon:
             self.spill.stats() if self.spill is not None else None,
             spill_ops=counters.spills,
             restore_ops=counters.restores,
+            job_spill_ops=job_spill_ops,
+            job_restore_ops=job_restore_ops,
             topk=self.config.memory_report_topk,
         )
 
@@ -5416,6 +5641,65 @@ class NodeDaemon:
         if self.config.memory_report_interval_s <= 0:
             summary["disabled"] = True
         return {"memory": summary}
+
+    def _h_transfer_summary(self, conn, msg):
+        """The cluster transfer matrix `ray_tpu memory --transfers` /
+        `/api/transfers` serve: per-(job, src, dst) flows with
+        bytes/ms/op counts, per-job get provenance + locality, the
+        hottest consumer task classes, and per-job spill/restore ops."""
+        if not self.is_head:
+            return self.head.call("transfer_summary", timeout=30.0)
+        self._refresh_memory_ledger()
+        summary = self._memory_ledger.transfer_summary()
+        if (
+            self.config.memory_report_interval_s <= 0
+            or self.config.transfer_report_interval_s <= 0
+        ):
+            summary["disabled"] = True
+        return {"transfers": summary}
+
+    def _h_object_locations(self, conn, msg):
+        """Head-side object location/size index (util.state
+        .object_locations): which nodes hold a copy of each sealed
+        object, its size and owner — the doctor's misplaced-task
+        conviction and user-level placement tooling read this instead
+        of scraping per-node object tables. Optional `oids` filters to
+        specific ids; largest first, `limit` caps rows."""
+        if not self.is_head:
+            fwd = {
+                k: msg[k] for k in ("oids", "limit") if k in msg
+            }
+            return self.head.call(
+                "object_locations", timeout=30.0, **fwd
+            )
+        limit = int(msg.get("limit", 1000))
+        wanted = None
+        if msg.get("oids"):
+            wanted = {ObjectID(b) for b in msg["oids"]}
+        with self._lock:
+            entries = [
+                (oid, e, tuple(e.locations))
+                for oid, e in self.objects.items()
+                if e.state == SEALED
+                and (wanted is None or oid in wanted)
+            ]
+        entries.sort(key=lambda item: item[1].size, reverse=True)
+        out = []
+        for oid, entry, locations in entries[:limit]:
+            out.append(
+                {
+                    "object_id": oid.hex(),
+                    "size": entry.size,
+                    "inline": entry.inline is not None,
+                    "nodes": sorted(
+                        NodeID(n).hex() for n in locations
+                    ),
+                    "spilled": entry.spilled,
+                    "job": entry.owner_job,
+                    "owner": entry.owner,
+                }
+            )
+        return {"locations": out}
 
     def _memory_verdict(
         self, leak_age_s: Optional[float] = None
@@ -5673,6 +5957,7 @@ class NodeDaemon:
                     "capture_stacks",
                     "limit",
                     "leak_age_s",
+                    "locality_miss_threshold",
                 )
                 if k in msg
             }
@@ -5803,6 +6088,44 @@ class NodeDaemon:
                 {
                     "kind": "spill_thrash",
                     "node_id": row["node"],
+                    "detail": row["detail"],
+                }
+            )
+        # Data plane: the transfer matrix folded from get/transfer
+        # records names the hottest cross-node flow, classifies each
+        # job's data_wait as pull- vs restore-dominated, and convicts
+        # misplaced task classes — a consumer pulling most of its
+        # bytes from a node that had capacity to run it is a
+        # scheduling bug an operator can fix, so it exits 1.
+        locality_threshold = float(
+            msg.get(
+                "locality_miss_threshold",
+                self.config.doctor_locality_miss_threshold,
+            )
+        )
+
+        def _node_has_capacity(node_hex: str) -> bool:
+            for info in self.control.alive_nodes():
+                if info.node_id.hex() != node_hex:
+                    continue
+                if info.available:
+                    return info.available.get("CPU", 0.0) >= 1.0
+                return info.resources.get("CPU", 0.0) >= 1.0
+            return False
+
+        data = self._memory_ledger.data_verdict(
+            locality_miss_threshold=locality_threshold,
+            node_has_capacity=_node_has_capacity,
+        )
+        for row in data.get("misplaced_tasks", ()):
+            problems.append(
+                {
+                    "kind": "misplaced_task",
+                    "task": row["task"],
+                    "job": row["job"],
+                    "src_node": row["src"],
+                    "remote_bytes": row["remote_bytes"],
+                    "remote_fraction": row["remote_fraction"],
                     "detail": row["detail"],
                 }
             )
@@ -6082,6 +6405,7 @@ class NodeDaemon:
                 "rl": rl,
                 "compile": compile_verdict,
                 "memory": memory,
+                "data": data,
                 "locks": locks,
                 "rpc": ring_digests,
                 "nodes": {
@@ -6092,6 +6416,7 @@ class NodeDaemon:
                     "hung_task_s": hung_s,
                     "straggler_threshold": threshold,
                     "leak_age_s": leak_age_s,
+                    "locality_miss_threshold": locality_threshold,
                 },
             }
         }

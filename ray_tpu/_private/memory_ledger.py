@@ -42,12 +42,31 @@ and the head's time-series ring, so trends survive the live window):
 * ``rt_job_chip_seconds_total``        counter {job}
 * ``rt_object_owner_bytes``           gauge    {job, owner kind}
 
-Label cardinality is bounded by construction: jobs are few, and the
-owner label carries the owning-context KIND (driver/task/actor),
-never a per-entity id — a per-id label would mint one Prometheus
-series per task over the cluster's lifetime, the exact pattern lint
-rule RT010 bans. The full per-owner map is served by
-``memory_summary`` / ``/api/memory``.
+Data-plane provenance (ISSUE 20): the ledger additionally folds the
+two record kinds the object read path emits through the metrics pipe —
+``transfer`` records (one per completed/aborted pull or spill restore,
+emitted by the daemon that moved the bytes) and ``get`` records
+(per-(provenance, src, task-class) aggregates drained from each
+worker's get path, never one record per get) — into a bounded
+per-(job, src_node, dst_node) transfer matrix plus per-job locality
+counters:
+
+* ``rt_object_transfer_bytes_total``  counter  {job, src, dst}
+* ``rt_object_pull_ms``               gauge    {job, src, dst} (mean)
+* ``rt_job_locality_hits_total``      counter  {job}
+* ``rt_job_locality_misses_total``    counter  {job}
+* ``rt_object_spills_total`` / ``rt_object_restores_total`` gain
+  per-``{job}`` tag series merged alongside the core per-node series
+
+Label cardinality is bounded by construction: jobs are few, src/dst
+are NODE ids (the matrix is at most jobs x nodes^2, flows evicted past
+``_MAX_FLOWS``), and the owner label carries the owning-context KIND
+(driver/task/actor), never a per-entity id — a per-id or per-flow-id
+label would mint one Prometheus series per task/transfer over the
+cluster's lifetime, the exact pattern lint rule RT010 bans. The full
+per-owner map is served by ``memory_summary`` / ``/api/memory``; the
+full matrix (with task-class attribution) by ``transfer_summary`` /
+``/api/transfers``.
 """
 
 from __future__ import annotations
@@ -61,6 +80,7 @@ __all__ = [
     "build_node_report",
     "MemoryLedger",
     "NEAR_CAPACITY_FRACTION",
+    "PROVENANCE_CLASSES",
 ]
 
 #: Arena used/capacity fraction past which a node is "near capacity"
@@ -81,6 +101,29 @@ _THRASH_MIN_OPS = 4
 #: smallest consumers are evicted (bounded head memory forever).
 _MAX_JOBS = 256
 
+#: (job, src, dst) transfer-matrix rows kept before the smallest flow
+#: is evicted — at most jobs x nodes^2 in practice, this cap is the
+#: backstop against job churn minting rows forever.
+_MAX_FLOWS = 512
+
+#: (job, task-class) get-attribution rows kept (task classes are code,
+#: bounded in practice; the cap bounds adversarial name churn).
+_MAX_TASK_ROWS = 256
+
+#: Remote bytes a task class must pull before the misplacement verdict
+#: will convict it — nobody gets paged over a 100 KB arg.
+_MISPLACED_MIN_BYTES = 1 << 20
+
+#: Get provenance classes the worker read path reports (see
+#: worker._record_get): where the resolved bytes actually came from.
+PROVENANCE_CLASSES = (
+    "inline",          # small value answered from a cache / the head table
+    "local",           # local arena hit (the copy was already here)
+    "pull",            # pulled from a remote node's arena
+    "restore_local",   # restored from THIS node's spill storage
+    "restore_remote",  # pulled from a REMOTE node's spill storage
+)
+
 
 def _flat_owner(job: str, owner: str) -> str:
     return f"{job}|{owner}"
@@ -93,6 +136,8 @@ def build_node_report(
     spill_stats: Optional[dict] = None,
     spill_ops: int = 0,
     restore_ops: int = 0,
+    job_spill_ops: Optional[Dict[str, int]] = None,
+    job_restore_ops: Optional[Dict[str, int]] = None,
     topk: int = 20,
     now: Optional[float] = None,
     pid_alive: Optional[Callable[[int], bool]] = None,
@@ -192,6 +237,17 @@ def build_node_report(
         "spilled_objects": int(spill_stats.get("spilled_objects", 0)),
         "spill_ops_total": int(spill_ops),
         "restore_ops_total": int(restore_ops),
+        # Cumulative per-job op counts (satellite: the verdict's
+        # restore-dominated call must be job-named, and node-level
+        # totals can't say WHOSE working set is paging). Latest-report
+        # semantics like every other field: the ledger sums the latest
+        # value across nodes, it never differences these.
+        "job_spill_ops": {
+            str(j): int(n) for j, n in (job_spill_ops or {}).items()
+        },
+        "job_restore_ops": {
+            str(j): int(n) for j, n in (job_restore_ops or {}).items()
+        },
         "owners": owners,
         "attributed_bytes": attributed,
         # Attribution is judged against what the arena reports in use:
@@ -235,6 +291,21 @@ class MemoryLedger:
         self._job_byte_s: Dict[str, float] = {}
         self._job_chip_s: Dict[str, float] = {}
         self._max_owner_series = max(1, int(max_owner_series))
+        # Transfer matrix: (job, src, dst) -> flow row. "bytes" counts
+        # only COMPLETED transfers — an aborted pull bumps "aborted"
+        # and nothing else, so a holder dying mid-pull can never be
+        # double-billed as moved bytes (the retry that succeeds bills
+        # them once).
+        self._flows: Dict[Tuple[str, str, str], dict] = {}
+        # Per-job get provenance: job -> {provenance: {gets, bytes,
+        # wait_ms}} (provenance keys from PROVENANCE_CLASSES — fixed).
+        self._job_prov: Dict[str, Dict[str, dict]] = {}
+        # Per-job locality counters: job -> [hits, misses].
+        self._locality: Dict[str, list] = {}
+        # Per-(job, task-class) get attribution for the misplacement
+        # verdict: remote vs local bytes, plus the src-node histogram
+        # of the remote share.
+        self._task_gets: Dict[Tuple[str, str], dict] = {}
 
     # -- folds ---------------------------------------------------------
     def fold(self, report: dict) -> None:
@@ -288,6 +359,112 @@ class MemoryLedger:
                 float(record.get("step_ms", 0.0)) / 1000.0,
             )
 
+    def record_transfer(
+        self,
+        job: str,
+        src: str,
+        dst: str,
+        kind: str,
+        nbytes: float,
+        ms: float = 0.0,
+    ) -> None:
+        """Fold one daemon-side transfer record into the matrix.
+
+        ``kind``: ``pull`` (remote arena -> dst), ``pull_spill``
+        (remote node's SPILL storage -> dst: restore traffic that also
+        crossed the wire), ``restore`` (dst's own spill -> dst arena),
+        or ``aborted`` (a pull that died mid-flight: counted, never
+        billed as transferred bytes)."""
+        key = (str(job or ""), str(src or ""), str(dst or ""))
+        with self._lock:
+            row = self._flows.get(key)
+            if row is None:
+                row = self._flows[key] = {
+                    "bytes": 0,
+                    "ms": 0.0,
+                    "pulls": 0,
+                    "restores": 0,
+                    "aborted": 0,
+                    "restored_bytes": 0,
+                }
+                if len(self._flows) > _MAX_FLOWS:
+                    victim = min(
+                        (k for k in self._flows if k != key),
+                        key=lambda k: self._flows[k]["bytes"],
+                    )
+                    self._flows.pop(victim)
+            if kind == "aborted":
+                row["aborted"] += 1
+                return
+            row["bytes"] += int(nbytes)
+            row["ms"] += float(ms)
+            if kind == "restore":
+                row["restores"] += 1
+                row["restored_bytes"] += int(nbytes)
+            else:
+                row["pulls"] += 1
+                if kind == "pull_spill":
+                    row["restored_bytes"] += int(nbytes)
+
+    def record_gets(
+        self,
+        job: str,
+        provenance: str,
+        src: str,
+        dst: str,
+        task: str,
+        count: float,
+        nbytes: float,
+        ms: float = 0.0,
+    ) -> None:
+        """Fold one worker-side get-provenance aggregate (a batch of
+        ``count`` gets that resolved the same way): per-job provenance
+        totals, the locality hit/miss counters, and the per-task-class
+        remote-vs-local attribution the misplacement verdict reads."""
+        job = str(job or "")
+        provenance = str(provenance or "")
+        if provenance not in PROVENANCE_CLASSES:
+            return
+        count = int(count)
+        nbytes = int(nbytes)
+        remote = provenance in ("pull", "restore_remote")
+        with self._lock:
+            prov = self._job_prov.get(job)
+            if prov is None:
+                if len(self._job_prov) >= _MAX_JOBS:
+                    return
+                prov = self._job_prov[job] = {}
+            row = prov.setdefault(
+                provenance, {"gets": 0, "bytes": 0, "wait_ms": 0.0}
+            )
+            row["gets"] += count
+            row["bytes"] += nbytes
+            row["wait_ms"] += float(ms)
+            loc = self._locality.setdefault(job, [0, 0])
+            if provenance in ("inline", "local"):
+                loc[0] += count
+            else:
+                loc[1] += count
+            tkey = (job, str(task or ""))
+            trow = self._task_gets.get(tkey)
+            if trow is None:
+                if len(self._task_gets) >= _MAX_TASK_ROWS:
+                    return
+                trow = self._task_gets[tkey] = {
+                    "remote_bytes": 0,
+                    "local_bytes": 0,
+                    "wait_ms": 0.0,
+                    "by_src": {},
+                }
+            trow["wait_ms"] += float(ms)
+            if remote:
+                trow["remote_bytes"] += nbytes
+                if src:
+                    by_src = trow["by_src"]
+                    by_src[src] = by_src.get(src, 0) + nbytes
+            else:
+                trow["local_bytes"] += nbytes
+
     def drop_node(self, node: str) -> None:
         """A node died: its arena is gone, so its report must not keep
         attributing bytes (the ledger's byte·s already banked what it
@@ -332,6 +509,25 @@ class MemoryLedger:
                     agg["objects"] += row["objects"]
                     agg["pinned_objects"] += row["pinned_objects"]
                     agg["spilled_bytes"] += row["spilled_bytes"]
+            for report in self.reports.values():
+                # Per-job spill/restore OPS (cumulative per node; the
+                # latest reports sum to the cluster total — these are
+                # never differenced, unlike the node-level rates).
+                for field, src_key in (
+                    ("spill_ops", "job_spill_ops"),
+                    ("restore_ops", "job_restore_ops"),
+                ):
+                    for job, n in report.get(src_key, {}).items():
+                        agg = out.setdefault(
+                            job,
+                            {
+                                "object_bytes": 0,
+                                "objects": 0,
+                                "pinned_objects": 0,
+                                "spilled_bytes": 0,
+                            },
+                        )
+                        agg[field] = agg.get(field, 0) + int(n)
             for job, total in self._job_byte_s.items():
                 out.setdefault(
                     job,
@@ -406,6 +602,76 @@ class MemoryLedger:
             "top_objects": top[: self._max_owner_series],
             "nodes": reports,
             "rates": rates,
+        }
+
+    def transfer_summary(self) -> dict:
+        """The data-plane view ``transfer_summary`` / ``/api/transfers``
+        / ``ray_tpu memory --transfers`` serve: the full per-(job, src,
+        dst) matrix (bytes descending), per-job get provenance and
+        locality, the top remote-pulling task classes, and per-job
+        spill/restore op totals."""
+        with self._lock:
+            flows = [
+                {
+                    "job": job,
+                    "src": src,
+                    "dst": dst,
+                    "cross_node": bool(src and dst and src != dst),
+                    **dict(row),
+                    "mb_per_s": (
+                        round(row["bytes"] / row["ms"] / 1e3, 2)
+                        if row["ms"] > 0
+                        else 0.0
+                    ),
+                }
+                for (job, src, dst), row in self._flows.items()
+            ]
+            provenance = {
+                job: {p: dict(r) for p, r in rows.items()}
+                for job, rows in self._job_prov.items()
+            }
+            locality = {
+                job: {
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_fraction": (
+                        round(hits / (hits + misses), 4)
+                        if hits + misses
+                        else 1.0
+                    ),
+                }
+                for job, (hits, misses) in self._locality.items()
+            }
+            tasks = [
+                {
+                    "job": job,
+                    "task": task,
+                    "remote_bytes": row["remote_bytes"],
+                    "local_bytes": row["local_bytes"],
+                    "wait_ms": round(row["wait_ms"], 3),
+                    "by_src": dict(row["by_src"]),
+                }
+                for (job, task), row in self._task_gets.items()
+            ]
+        flows.sort(key=lambda f: f["bytes"], reverse=True)
+        tasks.sort(key=lambda t: t["remote_bytes"], reverse=True)
+        jobs = self.jobs()
+        return {
+            "time": time.time(),
+            "flows": flows,
+            "provenance": provenance,
+            "locality": locality,
+            "tasks": tasks,
+            "job_spill_ops": {
+                job: row["spill_ops"]
+                for job, row in jobs.items()
+                if row.get("spill_ops")
+            },
+            "job_restore_ops": {
+                job: row["restore_ops"]
+                for job, row in jobs.items()
+                if row.get("restore_ops")
+            },
         }
 
     def metric_entries(self) -> Dict[str, dict]:
@@ -489,6 +755,114 @@ class MemoryLedger:
                     key: {"value": v} for key, v in by_kind.items()
                 },
             }
+        # Data-plane series. src_node/dst_node are NODE ids as
+        # SEPARATE labels — the only identity granularity RT010
+        # permits on these series (a per-object, per-transfer, or
+        # fused src-dst-pair label would mint unbounded Prometheus
+        # series). Tag keys stay alphabetical so they round-trip
+        # through prometheus._parse_tag_key like worker-built tags.
+        with self._lock:
+            flows = {k: dict(v) for k, v in self._flows.items()}
+            locality = {
+                job: tuple(hm) for job, hm in self._locality.items()
+            }
+        if flows:
+            entries["rt_object_transfer_bytes_total"] = {
+                "kind": "counter",
+                "unit": "bytes",
+                "description": (
+                    "Object bytes moved into each node's store per "
+                    "(job, src node, dst node): pulls plus spill "
+                    "restores; aborted pulls bill nothing"
+                ),
+                "total": sum(r["bytes"] for r in flows.values()),
+                "by_tags": {
+                    f"dst_node={dst}|job={job}|src_node={src}": {
+                        "total": row["bytes"]
+                    }
+                    for (job, src, dst), row in flows.items()
+                },
+            }
+            pull_ms = {
+                key: row
+                for key, row in flows.items()
+                if row["pulls"] + row["restores"] > 0
+            }
+            if pull_ms:
+                entries["rt_object_pull_ms"] = {
+                    "kind": "gauge",
+                    "unit": "ms",
+                    "description": (
+                        "Mean transfer latency per (job, src node, "
+                        "dst node) flow — cumulative detail is "
+                        "/api/transfers"
+                    ),
+                    "value": round(
+                        sum(r["ms"] for r in pull_ms.values())
+                        / max(
+                            1,
+                            sum(
+                                r["pulls"] + r["restores"]
+                                for r in pull_ms.values()
+                            ),
+                        ),
+                        3,
+                    ),
+                    "by_tags": {
+                        f"dst_node={dst}|job={job}|src_node={src}": {
+                            "value": round(
+                                row["ms"]
+                                / (row["pulls"] + row["restores"]),
+                                3,
+                            )
+                        }
+                        for (job, src, dst), row in pull_ms.items()
+                    },
+                }
+        if locality:
+            for name, index, what in (
+                ("rt_job_locality_hits_total", 0, "inline/local"),
+                ("rt_job_locality_misses_total", 1, "pull/restore"),
+            ):
+                entries[name] = {
+                    "kind": "counter",
+                    "unit": "gets",
+                    "description": (
+                        f"rt.get resolutions per job whose bytes were "
+                        f"{what}"
+                    ),
+                    "total": sum(hm[index] for hm in locality.values()),
+                    "by_tags": {
+                        f"job={job}": {"total": hm[index]}
+                        for job, hm in locality.items()
+                    },
+                }
+        # Per-job spill/restore op tag series, merged by the head's
+        # metrics_summary INTO the core per-node entries of the same
+        # name (node totals stay; the job dimension rides alongside).
+        jobs = self.jobs()
+        for name, field, what in (
+            ("rt_object_spills_total", "spill_ops", "spilled"),
+            ("rt_object_restores_total", "restore_ops", "restored"),
+        ):
+            per_job = {
+                job: row[field]
+                for job, row in jobs.items()
+                if row.get(field)
+            }
+            if per_job:
+                entries[name] = {
+                    "kind": "counter",
+                    "unit": "ops",
+                    "description": (
+                        f"Objects {what} (per-job attribution from "
+                        "the memory ledger)"
+                    ),
+                    "by_tags": {
+                        f"job={job}": {"total": n}
+                        for job, n in per_job.items()
+                    },
+                }
         return entries
 
     # -- doctor --------------------------------------------------------
@@ -612,5 +986,95 @@ class MemoryLedger:
             "params": {
                 "leak_age_s": leak_age_s,
                 "near_capacity_fraction": near_capacity_fraction,
+            },
+        }
+
+    def data_verdict(
+        self,
+        locality_miss_threshold: float = 0.5,
+        node_has_capacity: Optional[Callable[[str], bool]] = None,
+        min_remote_bytes: int = _MISPLACED_MIN_BYTES,
+    ) -> dict:
+        """``verdict.data``: (a) the hottest cross-node flow, (b) a
+        pull-dominated vs restore-dominated classification per job
+        that moved bytes (restore-dominated = the working set is
+        paging through spill, add memory; pull-dominated = the bytes
+        crossed nodes, fix placement), (c) misplaced-task suspects —
+        task classes whose gets pulled most of their bytes remotely
+        while a copy-holding node had capacity to run them.
+
+        ``node_has_capacity`` answers "could the src node have hosted
+        the task" (the head passes a scheduler-view probe); with no
+        probe every copy-holder is assumed to have had room — the
+        conservative direction for an observability verdict would be
+        the opposite, but an instrument that never convicts teaches
+        nothing, and the probe is always supplied in production.
+        """
+        node_has_capacity = node_has_capacity or (lambda node: True)
+        summary = self.transfer_summary()
+        hottest = None
+        for flow in summary["flows"]:
+            if flow["cross_node"] and flow["bytes"] > 0:
+                hottest = flow  # flows are bytes-descending
+                break
+        job_rows: Dict[str, dict] = {}
+        for flow in summary["flows"]:
+            row = job_rows.setdefault(
+                flow["job"],
+                {"transfer_bytes": 0, "restored_bytes": 0},
+            )
+            row["transfer_bytes"] += flow["bytes"]
+            row["restored_bytes"] += flow["restored_bytes"]
+        for job, row in job_rows.items():
+            pulled = row["transfer_bytes"] - row["restored_bytes"]
+            row["classification"] = (
+                "restore_dominated"
+                if row["restored_bytes"] >= max(1, pulled)
+                else "pull_dominated"
+            )
+            row["restore_ops"] = summary["job_restore_ops"].get(job, 0)
+        misplaced: List[dict] = []
+        for trow in summary["tasks"]:
+            total = trow["remote_bytes"] + trow["local_bytes"]
+            if (
+                trow["remote_bytes"] < min_remote_bytes
+                or not total
+                or trow["remote_bytes"] / total < locality_miss_threshold
+            ):
+                continue
+            if not trow["by_src"]:
+                continue
+            src = max(trow["by_src"], key=trow["by_src"].get)
+            if not node_has_capacity(src):
+                continue
+            frac = trow["remote_bytes"] / total
+            misplaced.append(
+                {
+                    "job": trow["job"],
+                    "task": trow["task"] or "driver",
+                    "remote_bytes": trow["remote_bytes"],
+                    "remote_fraction": round(frac, 4),
+                    "src": src,
+                    "wait_ms": trow["wait_ms"],
+                    "detail": (
+                        f"task class {trow['task'] or 'driver'!r} "
+                        f"(job {trow['job'][:8]}) pulled "
+                        f"{trow['remote_bytes'] / 1e6:.1f} MB remotely "
+                        f"({100 * frac:.0f}% of its get bytes), mostly "
+                        f"from node {src[:12]}, which had capacity — "
+                        "schedule it there (or co-locate its inputs) "
+                        "and those gets become local arena hits"
+                    ),
+                }
+            )
+        misplaced.sort(key=lambda s: s["remote_bytes"], reverse=True)
+        return {
+            "hottest_flow": hottest,
+            "jobs": job_rows,
+            "locality": summary["locality"],
+            "misplaced_tasks": misplaced,
+            "params": {
+                "locality_miss_threshold": locality_miss_threshold,
+                "min_remote_bytes": min_remote_bytes,
             },
         }

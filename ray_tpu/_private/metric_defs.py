@@ -66,6 +66,7 @@ CORE_METRICS: Dict[str, tuple] = {
     "rt_object_spills_total": ("counter", "spills", "Objects written to spill storage"),
     "rt_object_restores_total": ("counter", "restores", "Spilled objects restored into the arena"),
     "rt_object_pulls_total": ("counter", "pulls", "Cross-node object pulls started"),
+    "rt_object_pulls_aborted_total": ("counter", "pulls", "Cross-node pulls that died mid-flight (source gone/evicted); counted here, never billed as transferred bytes"),
     "rt_object_pull_chunks_total": ("counter", "chunks", "Object chunks fetched from remote nodes"),
     "rt_object_pushes_total": ("counter", "pushes", "Object chunks served to remote nodes"),
     # -- control plane (head) ----------------------------------------
@@ -135,6 +136,7 @@ class CoreCounters:
         "oom_kills",
         "lease_requests",
         "pulls",
+        "pulls_aborted",
         "pull_chunks",
         "pushes",
         "heartbeats",
@@ -289,6 +291,7 @@ def collect(daemon) -> Dict[str, float]:
     out["rt_object_spills_total"] = float(c.get("spills", 0))
     out["rt_object_restores_total"] = float(c.get("restores", 0))
     out["rt_object_pulls_total"] = float(c.get("pulls", 0))
+    out["rt_object_pulls_aborted_total"] = float(c.get("pulls_aborted", 0))
     out["rt_object_pull_chunks_total"] = float(c.get("pull_chunks", 0))
     out["rt_object_pushes_total"] = float(c.get("pushes", 0))
     out["rt_heartbeats_total"] = float(c.get("heartbeats", 0))

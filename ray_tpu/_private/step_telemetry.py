@@ -39,6 +39,7 @@ __all__ = [
     "report_step",
     "steps_to_chrome_trace",
     "goodput_from_records",
+    "stalls_active",
 ]
 
 _tl = threading.local()
@@ -105,6 +106,18 @@ class phase_timer:
         return False
 
 
+def stalls_active() -> bool:
+    """True when any phase_timer is currently open on this thread.
+
+    The worker's get path uses this to bill ``get_wait_ms`` only when
+    no enclosing instrumented phase (data_wait, h2d, send/recv, ...)
+    is already measuring the same wall — otherwise a get() issued
+    inside a timed data iterator would be billed twice and the phases
+    would stop partitioning the step wall."""
+    depths = getattr(_tl, "depths", None)
+    return bool(depths) and any(depths.values())
+
+
 class _TimedIterator:
     """Iterator wrapper accumulating the consumer-visible blocked time
     of each next() into a named phase. The wrap happens at the
@@ -149,9 +162,14 @@ def timed_iter(
 #: weight_sync is its drainless weight-publish stall. compile is XLA
 #: trace+compile time (_private/compile_watch.py bills it on digest
 #: misses) — the cold-compile step's cost, attributed instead of
-#: masquerading as a giant step_ms.
+#: masquerading as a giant step_ms. get_wait is object-plane blocked
+#: time: rt.get() waits billed by worker._record_get with the
+#: resolution's provenance (pull vs restore vs local — the transfer
+#: matrix says which), only when no enclosing phase already measures
+#: the same wall (see stalls_active).
 _TRACE_PHASES = (
     "data_wait_ms",
+    "get_wait_ms",
     "queue_wait_ms",
     "h2d_ms",
     "ckpt_block_ms",
@@ -241,8 +259,12 @@ def steps_to_chrome_trace(records) -> list:
 #: the same attribution from the rl_* series).
 #: compile is XLA's share of the wall: a loop whose goodput is eaten
 #: by compile_ms is recompiling (see verdict.compile), not slow.
+#: get_wait is the object plane's share: goodput eaten here means the
+#: loop blocks on rt.get — /api/transfers says whether those bytes
+#: were pulls, restores, or misplacement (README runbook).
 _STALL_PHASES = (
     "data_wait_ms",
+    "get_wait_ms",
     "queue_wait_ms",
     "h2d_ms",
     "ckpt_block_ms",

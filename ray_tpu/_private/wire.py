@@ -538,6 +538,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # memory ledger: per-node reports up, cluster view down
     "memory_report": {"report": dict},
     "memory_summary": {},
+    # data plane (ISSUE 20): transfer matrix + object-location index
+    "transfer_summary": {},
+    "object_locations": {
+        "?oids": list, "?limit": int,
+    },
     "metrics_timeseries": {
         "?name": (str, type(None)),
         "?since": _num,
@@ -560,6 +565,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "?hung_task_s": _num, "?straggler_threshold": _num,
         "?capture_stacks": bool, "?limit": int, "?leak_age_s": _num,
         "?compile_storm_threshold": _num,
+        "?locality_miss_threshold": _num,
     },
     # pubsub / log streaming
     "subscribe_logs": {"?channels": list},

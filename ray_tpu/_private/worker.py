@@ -126,6 +126,10 @@ class _TaskContext(threading.local):
         #: a still-pending direct result as an arg — such specs must
         #: ride their own frame (see direct._Pending.solo).
         self.pending_direct_dep = False
+        #: Name of the task CLASS currently executing on this thread
+        #: ("" on the driver): get-provenance aggregates key on it so
+        #: the doctor can convict a misplaced task class, never an id.
+        self.task_name = ""
 
 
 _worker_generation = itertools.count()
@@ -499,6 +503,18 @@ class CoreWorker:
         #: message ordered after it). Entries die with the local ref.
         #: (reference: CoreWorkerMemoryStore for small owned objects.)
         self._inline_cache: Dict[ObjectID, bytes] = {}
+        #: Get-provenance aggregates: (provenance, src_node, task)
+        #: -> [count, bytes, wait_ms]. Drained onto the metrics pipe
+        #: once per flush tick (util.metrics._Buffer drain hook) —
+        #: classification happens HERE at the source, and the wire
+        #: cost is one aggregate record per distinct key per tick,
+        #: never a per-get RPC.
+        self._get_stats: Dict[tuple, list] = {}
+        self._get_stats_lock = threading.Lock()
+        #: Buffer generation the drain hook is registered on (fork /
+        #: shutdown build a new buffer; re-register lazily).
+        self._get_stats_buf = None
+        self._get_stats_drained = 0.0
         #: Batched ref-release notifications: one daemon wakeup per
         #: batch instead of one per ObjectRef GC (the wakeup cost
         #: dominates on small hosts). A parked flusher thread drains
@@ -773,6 +789,94 @@ class CoreWorker:
             out.append(self._get_one(ref.id(), remaining))
         return out
 
+    #: Daemon ObjectEntry.source marker -> the provenance class billed
+    #: to the consumer (absent marker = warm local arena hit).
+    _VIA_PROVENANCE = {
+        "pull": "pull",
+        "pull_spill": "restore_remote",
+        "restore": "restore_local",
+    }
+
+    def _record_get(
+        self, provenance: str, src: str, nbytes: int, ms: float
+    ) -> None:
+        """Classify ONE rt.get resolution at the source and fold it
+        into this process's aggregate table. O(one dict update) — this
+        is the per-get cost the `get_provenance_overhead_us` bench
+        bars; the wire cost is one record per distinct (provenance,
+        src, task) per drain, riding the metrics flush tick. Never a
+        per-get RPC."""
+        if self.config.transfer_report_interval_s <= 0:
+            return
+        key = (provenance, src, self._ctx.task_name)
+        with self._get_stats_lock:
+            row = self._get_stats.get(key)
+            if row is None:
+                self._get_stats[key] = [1, nbytes, ms]
+            else:
+                row[0] += 1
+                row[1] += nbytes
+                row[2] += ms
+        if ms > 0.0 and self._ctx.task_id is not None:
+            # Bill the wait as its own step phase — only while
+            # executing a task (driver-side gets between steps would
+            # pollute the NEXT report_step's phase bucket with
+            # unrelated wall), and only when no enclosing phase_timer
+            # (data_wait, recv, ...) is already measuring this wall;
+            # phases must stay a partition of the step.
+            from .step_telemetry import add_phase, stalls_active
+
+            if not stalls_active():
+                add_phase("get_wait_ms", ms)
+        self._ensure_get_drain()
+
+    def _ensure_get_drain(self) -> None:
+        """Register the drain hook on the CURRENT buffer generation
+        (fork and shutdown drop the singleton; re-register lazily)."""
+        from ..util.metrics import _Buffer
+
+        buf = _Buffer.get()
+        if self._get_stats_buf is buf:
+            return
+        self._get_stats_buf = buf  # rt: noqa[RT201] — add_drain_hook is idempotent; a racing duplicate registration is a no-op
+        buf.add_drain_hook(self._drain_get_stats)
+
+    def _drain_get_stats(self) -> None:
+        """Pre-flush drain: push one aggregate "get" record per
+        distinct key, rate-limited by `transfer_report_interval_s`."""
+        now = time.monotonic()
+        with self._get_stats_lock:
+            if (
+                now - self._get_stats_drained
+                < self.config.transfer_report_interval_s
+            ):
+                return
+            self._get_stats_drained = now
+            stats, self._get_stats = self._get_stats, {}
+        if not stats:
+            return
+        from ..util.metrics import _Buffer
+
+        buf = _Buffer.get()
+        node = self.node_id.hex()
+        job = self.job_id.hex()
+        for (prov, src, task), (count, nbytes, ms) in stats.items():
+            buf.push(
+                (
+                    "get",
+                    prov,
+                    float(count),
+                    (
+                        ("bytes", str(int(nbytes))),
+                        ("job", job),
+                        ("ms", str(round(ms, 3))),
+                        ("node", node),
+                        ("src", src),
+                        ("task", task),
+                    ),
+                )
+            )
+
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
         rec = _flight()
         if not rec.enabled:
@@ -787,6 +891,7 @@ class CoreWorker:
             # acquisitions). Resolved right here so the hot path pays
             # ONE lock acquisition, not a probe plus the inner
             # lookup.
+            self._record_get("inline", "", len(cached), 0.0)
             return self.serialization.deserialize(cached)
         t0 = time.monotonic()
         try:
@@ -808,9 +913,11 @@ class CoreWorker:
         self, oid: ObjectID, timeout: Optional[float]
     ) -> Any:
         deadline = None if timeout is None else time.time() + timeout
+        t0 = time.monotonic()
         with self._ref_lock:
             cached = self._inline_cache.get(oid)
         if cached is not None:
+            self._record_get("inline", "", len(cached), 0.0)
             return self.serialization.deserialize(cached)
         if self._direct is not None:
             entry = self._direct.lookup(oid)
@@ -830,12 +937,23 @@ class CoreWorker:
                         raise_from_payload(fut.error)
                     kind, payload = fut.results[index]
                     if kind == "inline":
+                        self._record_get(
+                            "inline", "", len(payload),
+                            (time.monotonic() - t0) * 1e3,
+                        )
                         return self.serialization.deserialize(payload)
                     remaining = (
                         None if deadline is None
                         else deadline - time.time()
                     )
-                    return self._read_local_store(oid, payload, remaining)
+                    value = self._read_local_store(
+                        oid, payload, remaining
+                    )
+                    self._record_get(
+                        "local", "", int(payload),
+                        (time.monotonic() - t0) * 1e3,
+                    )
+                    return value
                 # fell back to the daemon path: ask it below
         while True:
             timeout = None if deadline is None else deadline - time.time()
@@ -852,12 +970,29 @@ class CoreWorker:
             if "error" in reply and reply["error"] is not None:
                 raise_from_payload(reply["error"])
             if reply.get("inline") is not None:
+                self._record_get(
+                    "inline", "", len(reply["inline"]),
+                    (time.monotonic() - t0) * 1e3,
+                )
                 return self.serialization.deserialize(reply["inline"])
             remaining = None if deadline is None else deadline - time.time()
             try:
-                return self._read_local_store(
+                value = self._read_local_store(
                     oid, reply["shm_size"], remaining
                 )
+                # Classify at the source: the daemon's reply says how
+                # this node's copy materialised (absent via = warm
+                # local hit), so the wait bills to the right
+                # provenance class without any extra round trip.
+                self._record_get(
+                    self._VIA_PROVENANCE.get(
+                        reply.get("via"), "local"
+                    ),
+                    str(reply.get("src", "")),
+                    int(reply["shm_size"]),
+                    (time.monotonic() - t0) * 1e3,
+                )
+                return value
             except FileNotFoundError:
                 # The daemon spilled/evicted the segment between its
                 # reply and our attach; re-ask — the daemon's get path
@@ -1615,6 +1750,7 @@ class CoreWorker:
         self._ctx.task_id = task_id
         self._ctx.put_index = 0
         self._ctx.submit_index = 0
+        self._ctx.task_name = spec.get("name") or spec["kind"]
         # Actor methods inherit the capture context the actor was
         # created with (the creation spec carried it).
         self._ctx.pg_context = spec.get("pg_context") or (
@@ -1764,6 +1900,7 @@ class CoreWorker:
                 )
             self._ctx.task_id = None
             self._ctx.pg_context = None
+            self._ctx.task_name = ""
         if reply_to is not None:
             # Direct transport: results ride the reply — small ones
             # inline (never touching the daemon), large ones sealed
@@ -1848,6 +1985,7 @@ class CoreWorker:
         # path always gave fresh deserializations).
         inline_payloads: Dict[bytes, Any] = {}
         shm_sizes: Dict[bytes, int] = {}
+        via_src: Dict[bytes, tuple] = {}
         unique = list(dict.fromkeys(oid_blobs))
         remote: List[bytes] = []
         for blob in unique:
@@ -1873,23 +2011,36 @@ class CoreWorker:
                     inline_payloads[blob] = res["inline"]
                 elif res.get("shm_size") is not None:
                     shm_sizes[blob] = res["shm_size"]
+                    if res.get("via"):
+                        via_src[blob] = (
+                            res["via"], str(res.get("src", ""))
+                        )
                 # pending: blocking fallback below
         out = []
         for blob in oid_blobs:
             if blob in inline_payloads:
-                out.append(
-                    self.serialization.deserialize(inline_payloads[blob])
-                )
+                payload = inline_payloads[blob]
+                self._record_get("inline", "", len(payload), 0.0)
+                out.append(self.serialization.deserialize(payload))
             elif blob in shm_sizes:
+                t0 = time.monotonic()
                 try:
-                    out.append(self._read_local_store(
+                    value = self._read_local_store(
                         ObjectID(blob), shm_sizes[blob], 30.0
-                    ))
+                    )
                 except (FileNotFoundError, exc.GetTimeoutError):
                     # evicted mid-fetch: blocking path re-pulls
                     out.append(
                         self._get_one(ObjectID(blob), timeout=None)
                     )
+                    continue
+                via, src = via_src.get(blob, (None, ""))
+                self._record_get(
+                    self._VIA_PROVENANCE.get(via, "local"), src,
+                    shm_sizes[blob],
+                    (time.monotonic() - t0) * 1e3,
+                )
+                out.append(value)
             else:
                 out.append(self._get_one(ObjectID(blob), timeout=None))
         return out

@@ -194,6 +194,7 @@ def diagnose(
     straggler_threshold: Optional[float] = None,
     capture_stacks: bool = True,
     leak_age_s: Optional[float] = None,
+    locality_miss_threshold: Optional[float] = None,
 ) -> dict:
     """Stall doctor: one verdict over head task state, per-worker
     in-flight views, step telemetry, and flight-recorder digests —
@@ -209,7 +210,12 @@ def diagnose(
     code covers deadlock risk). The CLI surface is
     `ray_tpu doctor`; thresholds default
     to the cluster config (`doctor_hung_task_s`,
-    `doctor_straggler_threshold`, `doctor_leak_age_s`)."""
+    `doctor_straggler_threshold`, `doctor_leak_age_s`) — plus
+    `verdict.data`: the hottest cross-node flow from the transfer
+    matrix, pull- vs restore-dominated classification per job, and
+    misplaced-task suspects (task classes pulling most of their get
+    bytes from a node that had capacity to run them;
+    `doctor_locality_miss_threshold` sets the conviction bar)."""
     kwargs: Dict[str, Any] = {"capture_stacks": capture_stacks}
     if hung_task_s is not None:
         kwargs["hung_task_s"] = float(hung_task_s)
@@ -217,6 +223,10 @@ def diagnose(
         kwargs["straggler_threshold"] = float(straggler_threshold)
     if leak_age_s is not None:
         kwargs["leak_age_s"] = float(leak_age_s)
+    if locality_miss_threshold is not None:
+        kwargs["locality_miss_threshold"] = float(
+            locality_miss_threshold
+        )
     # Step records may still sit in this process's metrics buffer.
     # Best-effort: a doctor run against a sick cluster must not die
     # on the flush that the verdict would have explained.

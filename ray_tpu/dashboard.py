@@ -14,6 +14,9 @@ Endpoints: /            — HTML summary page (auto-refreshing)
                           in-flight, queue depth)
            /api/memory  — cluster memory ledger (per-job/-owner
                           attribution, leak suspects, verdict.memory)
+           /api/transfers — data-plane transfer matrix (per-(job,
+                          src, dst) flows, get provenance, locality
+                          hit rates, top remote-pulling task classes)
            /api/timeseries?name=...&since=...&limit=...
                         — head snapshot-ring history
            /metrics     — Prometheus exposition text (0.0.4)
@@ -61,7 +64,7 @@ _PAGE = """<!doctype html>
  <div id="view"></div>
 </main>
 <script>
-const TABS = ["nodes","actors","tasks","objects","memory",
+const TABS = ["nodes","actors","tasks","objects","memory","transfers",
               "placement_groups","resources","metrics","serve",
               "spans","steps","compile","doctor"];
 let active = "nodes";
@@ -129,6 +132,27 @@ async function tick() {
           attributed:n.attributed_bytes, spilled:n.spilled_bytes}))) +
         "<h3>top objects</h3>" + table(data.top_objects||[]) +
         "<h3>verdict</h3>" + table(problems);
+    } else if (tab === "transfers") {
+      // Same nested-payload shape as memory: section tables, not a
+      // flat spread.
+      $("view").innerHTML =
+        (data.disabled ? "<p><i>transfer instrumentation disabled " +
+          "(transfer_report_interval_s or memory_report_interval_s " +
+          "&le; 0)</i></p>" : "") +
+        "<h3>flows (src &rarr; dst)</h3>" + table(data.flows||[]) +
+        "<h3>provenance by job</h3>" + table(
+          Object.entries(data.provenance||{}).map(([k,r]) =>
+            ({job:k, ...r}))) +
+        "<h3>locality</h3>" + table(
+          Object.entries(data.locality||{}).map(([k,r]) =>
+            ({job:k, ...r}))) +
+        "<h3>top remote-pulling task classes</h3>" +
+          table(data.tasks||[]) +
+        "<h3>spill/restore ops by job</h3>" + table(
+          [...new Set([...Object.keys(data.job_spill_ops||{}),
+                       ...Object.keys(data.job_restore_ops||{})])]
+            .map(k => ({job:k, spills:(data.job_spill_ops||{})[k]||0,
+                        restores:(data.job_restore_ops||{})[k]||0})));
     } else $("view").innerHTML = table(
       tab === "resources" || tab === "metrics" || tab === "steps" ||
       tab === "serve" || tab === "compile"
@@ -201,6 +225,7 @@ class Dashboard:
             },
             "metrics": self._metrics,
             "memory": self._memory,
+            "transfers": self._transfers,
             "serve": self._serve,
             "spans": self._spans,
             "steps": self._steps,
@@ -228,6 +253,17 @@ class Dashboard:
         from .util.state import memory_summary
 
         return memory_summary()
+
+    @staticmethod
+    def _transfers():
+        """/api/transfers — the cluster transfer matrix: per-(job,
+        src_node, dst_node) flows with bytes/pulls/restores/aborts,
+        per-job get provenance and locality hit rates, and the top
+        remote-pulling task classes (see
+        `ray_tpu memory --transfers`)."""
+        from .util.state import transfer_summary
+
+        return transfer_summary()
 
     @staticmethod
     def _serve():

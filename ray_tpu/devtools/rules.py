@@ -490,8 +490,13 @@ class UnboundedMetricLabels(Rule):
     ledger deliberately exports only top-K owners for exactly this
     reason; per-id detail belongs in the state API
     (`ray_tpu state ls objects`), traces, or the flight recorder.
-    Scope: metric declarations (`tag_keys=`) and record sites
-    (`.inc/.set/.observe(tags={...})`) in the package."""
+    Also banned: pre-joined src/dst PAIR keys (`flow`, `src_dst`,
+    `pair`, `edge`, `route`) — the transfer matrix keys on src_node
+    and dst_node as SEPARATE labels (N + N series each, and PromQL
+    can aggregate either side); a fused pair label is N² cardinality
+    that no aggregation can take apart. Scope: metric declarations
+    (`tag_keys=`) and record sites (`.inc/.set/.observe(tags={...})`)
+    in the package."""
 
     id = "RT010"
     title = "unbounded-cardinality metric label (per-request/object id)"
@@ -505,11 +510,20 @@ class UnboundedMetricLabels(Rule):
     #: compile-watch case: one series per arg-shape set is unbounded
     #: under exactly the recompile storm the series exists to catch —
     #: compile metrics carry the program NAME only, digests stay in
-    #: the bounded diagnostic ring (compile_watch.py).
+    #: the bounded diagnostic ring (compile_watch.py). src_node /
+    #: dst_node are each ALLOWED (node granularity is bounded and the
+    #: transfer matrix keys on them by design); what is banned is any
+    #: fused src-dst PAIR key — N² series that no PromQL aggregation
+    #: can decompose back into per-node sums. `edge` is deliberately
+    #: absent: the compiled-DAG channel metrics key on it and a
+    #: static DAG's edge set is bounded by the program, not the
+    #: cluster — the dynamic pair keys below are what RT010 rejects.
     _BANNED = re.compile(
         r"^(request|object|task|actor|worker|span|trace|lease|"
-        r"session|batch)_?id$|^(oid|tid|rid)$|"
-        r"^(shape_)?digest$|^shapes?$"
+        r"session|batch|flow|transfer|pull)_?id$|^(oid|tid|rid)$|"
+        r"^(shape_)?digest$|^shapes?$|"
+        r"^flow$|^(src_dst|dst_src)(_pair)?$|^(node_)?pair$|^route$|"
+        r"^(object|obj)_?ref$"
     )
 
     def _flag(self, key: str, where: str, anchor) -> Iterable[Hit]:

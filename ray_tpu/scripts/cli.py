@@ -294,6 +294,9 @@ def cmd_memory(args) -> None:
     _connect(args)
     from ..util.state import memory_summary
 
+    if getattr(args, "transfers", False):
+        _print_transfers(args)
+        return
     mem = memory_summary()
     verdict = mem.get("verdict") or {}
     problems = _memory_problems(verdict)
@@ -374,6 +377,67 @@ def cmd_memory(args) -> None:
     for problem in problems:
         print(f"  {problem.get('detail')}")
     sys.exit(1)
+
+
+def _print_transfers(args) -> None:
+    """`ray_tpu memory --transfers` — the cluster transfer matrix:
+    who moved which job's bytes where, how long the moves took, and
+    how each job's gets resolved (provenance + locality)."""
+    from ..util.state import transfer_summary
+
+    transfers = transfer_summary()
+    if args.as_json:
+        print(json.dumps(transfers, indent=2, default=str))
+        return
+    if transfers.get("disabled"):
+        print(
+            "transfer matrix disabled (memory_report_interval_s=0 "
+            "or transfer_report_interval_s=0)"
+        )
+        return
+    flows = transfers.get("flows") or []
+    if not flows:
+        print("no transfers recorded")
+    else:
+        print(f"{len(flows)} flow(s), bytes descending:")
+        for flow in flows:
+            arrow = (
+                f"{(flow.get('src') or '?')[:12]} -> "
+                f"{(flow.get('dst') or '?')[:12]}"
+            )
+            print(
+                f"  job {(flow.get('job') or '-')[:8]} {arrow}: "
+                f"{flow.get('bytes', 0) / 1e6:.1f} MB in "
+                f"{flow.get('pulls', 0)} pull(s), "
+                f"{flow.get('restores', 0)} restore(s), "
+                f"{flow.get('aborted', 0)} aborted, "
+                f"{flow.get('ms', 0.0):.1f} ms "
+                f"({flow.get('mb_per_s', 0.0):.1f} MB/s)"
+            )
+    locality = transfers.get("locality") or {}
+    for job, row in locality.items():
+        print(
+            f"  job {job[:8]} locality: {row.get('hits', 0)} hit(s) / "
+            f"{row.get('misses', 0)} miss(es) "
+            f"({100.0 * row.get('hit_fraction', 0.0):.1f}% local)"
+        )
+    if args.verbose:
+        print("get provenance by job:")
+        for job, provs in (transfers.get("provenance") or {}).items():
+            for prov, row in provs.items():
+                print(
+                    f"  job {job[:8]} {prov}: {row.get('gets', 0)} "
+                    f"get(s), {row.get('bytes', 0) / 1e6:.1f} MB, "
+                    f"{row.get('wait_ms', 0.0):.1f} ms waited"
+                )
+        print("top remote-pulling task classes:")
+        for row in transfers.get("tasks") or []:
+            print(
+                f"  {row.get('task') or 'driver'} "
+                f"(job {(row.get('job') or '-')[:8]}): "
+                f"{row.get('remote_bytes', 0) / 1e6:.1f} MB remote / "
+                f"{row.get('local_bytes', 0) / 1e6:.1f} MB local"
+            )
 
 
 def cmd_timeline(args) -> None:
@@ -625,6 +689,7 @@ def cmd_doctor(args) -> None:
         straggler_threshold=args.straggler_threshold,
         capture_stacks=not args.no_stacks,
         leak_age_s=args.leak_age_s,
+        locality_miss_threshold=args.locality_miss_threshold,
     )
     if args.trace:
         # One chrome trace out of all three streams: task slices
@@ -711,6 +776,30 @@ def cmd_doctor(args) -> None:
             f"{len(memory.get('near_capacity') or ())} node(s) near "
             "capacity"
         )
+    data = verdict.get("data") or {}
+    hottest = data.get("hottest_flow")
+    if hottest or data.get("misplaced_tasks"):
+        jobs = data.get("jobs") or {}
+        restore_jobs = sum(
+            1
+            for row in jobs.values()
+            if row.get("classification") == "restore_dominated"
+        )
+        line = (
+            "data plane: "
+            f"{len(data.get('misplaced_tasks') or ())} misplaced "
+            f"task class(es), {restore_jobs} restore-dominated "
+            "job(s)"
+        )
+        if hottest:
+            line += (
+                "; hottest flow "
+                f"{(hottest.get('src') or '?')[:12]} -> "
+                f"{(hottest.get('dst') or '?')[:12]} "
+                f"({hottest.get('bytes', 0) / 1e6:.1f} MB, job "
+                f"{(hottest.get('job') or '-')[:8]})"
+            )
+        print(line)
     if verdict.get("healthy"):
         print("verdict: HEALTHY")
         return
@@ -971,6 +1060,11 @@ def main(argv=None) -> None:
         "-v", "--verbose", action="store_true",
         help="also print the top-owner and top-object tables",
     )
+    p_mem.add_argument(
+        "--transfers", action="store_true",
+        help="print the cluster transfer matrix instead: per-(job, "
+        "src, dst) flows, get provenance, and locality hit rates",
+    )
     p_mem.set_defaults(fn=cmd_memory)
 
     p_tl = sub.add_parser(
@@ -1124,6 +1218,12 @@ def main(argv=None) -> None:
         "--leak-age-s", type=float, default=None,
         help="an object held past this age by a dead owner is a "
         "leak suspect (default: cluster config doctor_leak_age_s)",
+    )
+    p_doc.add_argument(
+        "--locality-miss-threshold", type=float, default=None,
+        help="convict a task class as misplaced when at least this "
+        "fraction of its get bytes pulled remotely (default: cluster "
+        "config doctor_locality_miss_threshold)",
     )
     p_doc.add_argument(
         "--no-stacks", action="store_true",

@@ -62,6 +62,13 @@ class _Buffer:
         self._sender = uuid.uuid4().hex
         self._seq = 0
         self._sealed: List[Tuple[int, List[tuple]]] = []
+        # Pre-flush drains: callables that push their own aggregated
+        # records right before each seal (the worker's get-provenance
+        # aggregates ride these — batched per flush tick, never one
+        # record per get). Registered per buffer generation: fork and
+        # shutdown drop the singleton, so hooks never outlive the
+        # session they aggregate for.
+        self._drain_hooks: List = []
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -94,6 +101,14 @@ class _Buffer:
     def push(self, record: tuple) -> None:
         with self.records_lock:
             self.records.append(record)
+
+    def add_drain_hook(self, hook) -> None:
+        """Register a callable run before each flush seals a batch
+        (idempotent per hook object). Hooks push records via push();
+        a raising hook is dropped from the list, never the flush."""
+        with self.records_lock:
+            if hook not in self._drain_hooks:
+                self._drain_hooks.append(hook)
 
     def _loop(self) -> None:
         while not self._stop.wait(_FLUSH_INTERVAL_S):
@@ -128,6 +143,16 @@ class _Buffer:
             self._sealed = trimmed
 
     def flush(self, raise_on_error: bool = True) -> None:
+        with self.records_lock:
+            hooks = list(self._drain_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:
+                # A broken drain must not wedge every future flush.
+                with self.records_lock:
+                    if hook in self._drain_hooks:
+                        self._drain_hooks.remove(hook)
         with self.records_lock:
             self._seal_and_trim_locked()
             pending = list(self._sealed)

@@ -52,8 +52,10 @@ def list_objects(limit: int = 1000) -> List[dict]:
     applying `limit` (the old dict-order truncation dropped an
     arbitrary slice — the big consumers an operator is after; same
     bug class as the list_tasks newest-first fix). Rows carry the
-    ledger's attribution columns: job, owner, age_s, spilled,
-    pinned."""
+    ledger's attribution columns (job, owner, age_s, spilled, pinned)
+    and the data-plane columns: node (a copy holder), copies (how
+    many nodes hold one), source (how this node's copy materialised:
+    inline/local/pull/pull_spill/restore)."""
     rows = _worker().call("list_objects", limit=limit)["objects"]
     # Defensive re-sort: a pre-ledger head returns creation order.
     rows.sort(key=lambda r: int(r.get("size") or 0), reverse=True)
@@ -67,6 +69,35 @@ def memory_summary() -> dict:
     `verdict.memory` (near-capacity nodes, leak suspects, spill
     thrash) over the same data."""
     return _worker().call("memory_summary", timeout=30.0)["memory"]
+
+
+def transfer_summary() -> dict:
+    """The cluster transfer matrix (`ray_tpu memory --transfers` /
+    `/api/transfers`): per-(job, src_node, dst_node) flows with
+    bytes/ms/pull/restore/abort counts, per-job get provenance
+    (inline / local / pull / restore_local / restore_remote) and
+    locality hit rates, the top remote-pulling task classes, and
+    per-job spill/restore op totals."""
+    return _worker().call("transfer_summary", timeout=30.0)[
+        "transfers"
+    ]
+
+
+def object_locations(
+    object_ids: Optional[List[str]] = None, limit: int = 1000
+) -> List[dict]:
+    """Head-side object location/size index: for each sealed object,
+    the nodes holding a copy, its size, owner, and whether it is
+    spilled — size-descending. `object_ids` (hex) filters to specific
+    objects. This is the index the doctor's misplaced-task conviction
+    reads; use it to check where a ref's bytes live before deciding
+    where to schedule its consumer."""
+    kwargs: dict = {"limit": int(limit)}
+    if object_ids is not None:
+        kwargs["oids"] = [bytes.fromhex(o) for o in object_ids]
+    return _worker().call(
+        "object_locations", timeout=30.0, **kwargs
+    )["locations"]
 
 
 def list_placement_groups() -> List[dict]:
@@ -167,6 +198,8 @@ __all__ = [
     "list_objects",
     "list_placement_groups",
     "memory_summary",
+    "transfer_summary",
+    "object_locations",
     "summarize",
     "event_stats",
     "profile_worker",

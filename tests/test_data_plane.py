@@ -1,0 +1,522 @@
+"""Data-plane observability tests (ISSUE 20): get-path provenance,
+the head's per-(job, src_node, dst_node) transfer matrix, the
+object-location index, and the doctor's locality verdict.
+
+Reference behavior model: ray's object-store metrics + the locality
+half of `ray memory` — here the classification happens at the get
+resolution site (inline / local arena / remote pull / spill restore),
+rides the existing metrics pipe (never a per-get RPC), and the head
+folds it into the MemoryLedger's bounded flow matrix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MB = 1024 * 1024
+
+
+# -- ledger units (no cluster) ----------------------------------------
+
+
+def _ledger():
+    from ray_tpu._private.memory_ledger import MemoryLedger
+
+    return MemoryLedger()
+
+
+def test_transfer_matrix_folds_and_sorts():
+    led = _ledger()
+    led.record_transfer("jobA", "n1", "n2", "pull", 8 * MB, ms=10.0)
+    led.record_transfer("jobA", "n1", "n2", "pull", 2 * MB, ms=5.0)
+    led.record_transfer("jobB", "n2", "n2", "restore", MB, ms=1.0)
+    s = led.transfer_summary()
+    assert s["flows"][0]["bytes"] == 10 * MB  # bytes-descending
+    top = s["flows"][0]
+    assert (top["job"], top["src"], top["dst"]) == ("jobA", "n1", "n2")
+    assert top["cross_node"] is True
+    assert top["pulls"] == 2
+    assert top["mb_per_s"] > 0
+    restore = s["flows"][1]
+    assert restore["cross_node"] is False
+    assert restore["restores"] == 1
+    assert restore["restored_bytes"] == MB
+
+
+def test_aborted_pull_counted_never_billed_as_bytes():
+    """The chaos contract: a pull that dies mid-flight bumps the
+    flow's aborted count and NOTHING else — the retry that succeeds
+    bills the bytes exactly once."""
+    led = _ledger()
+    led.record_transfer("j", "n1", "n2", "aborted", 8 * MB, ms=3.0)
+    row = led.transfer_summary()["flows"][0]
+    assert row["aborted"] == 1
+    assert row["bytes"] == 0
+    assert row["pulls"] == 0
+    led.record_transfer("j", "n1", "n2", "pull", 8 * MB, ms=12.0)
+    row = led.transfer_summary()["flows"][0]
+    assert row["bytes"] == 8 * MB  # billed once, by the success
+    assert row["aborted"] == 1
+
+
+def test_flow_table_bounded():
+    from ray_tpu._private.memory_ledger import _MAX_FLOWS
+
+    led = _ledger()
+    for i in range(_MAX_FLOWS + 50):
+        led.record_transfer("j", f"src{i}", "dst", "pull", i + 1)
+    flows = led.transfer_summary()["flows"]
+    assert len(flows) <= _MAX_FLOWS
+    # Smallest-bytes flows were the evictees: the hot flows survive.
+    assert flows[0]["bytes"] == _MAX_FLOWS + 50
+
+
+def test_record_gets_provenance_locality_and_task_attribution():
+    led = _ledger()
+    led.record_gets("j", "local", "", "n1", "t", 3, 3 * MB)
+    led.record_gets("j", "pull", "n9", "n1", "t", 1, 8 * MB, ms=5.0)
+    led.record_gets("j", "restore_local", "", "n1", "t", 1, MB, ms=2.0)
+    led.record_gets("j", "bogus", "", "n1", "t", 9, 9 * MB)  # dropped
+    s = led.transfer_summary()
+    prov = s["provenance"]["j"]
+    assert set(prov) == {"local", "pull", "restore_local"}
+    assert prov["pull"] == {"gets": 1, "bytes": 8 * MB, "wait_ms": 5.0}
+    # inline/local are hits; pull and BOTH restore classes are misses
+    # (a restore means the working set left the arena).
+    assert s["locality"]["j"]["hits"] == 3
+    assert s["locality"]["j"]["misses"] == 2
+    task = s["tasks"][0]
+    assert task["task"] == "t"
+    assert task["remote_bytes"] == 8 * MB  # pull/restore_remote only
+    assert task["local_bytes"] == 4 * MB
+    assert task["by_src"] == {"n9": 8 * MB}
+
+
+def test_metric_entries_expose_transfer_series():
+    led = _ledger()
+    led.record_transfer("j", "n1", "n2", "pull", 4 * MB, ms=8.0)
+    led.record_gets("j", "pull", "n1", "n2", "t", 2, 4 * MB, ms=8.0)
+    led.record_gets("j", "local", "", "n2", "t", 6, MB)
+    entries = led.metric_entries()
+    xfer = entries["rt_object_transfer_bytes_total"]
+    assert xfer["kind"] == "counter"
+    assert xfer["total"] == 4 * MB
+    (tag_key,) = xfer["by_tags"]
+    # src/dst at NODE granularity as SEPARATE labels — the only
+    # identity shape lint rule RT010 permits on these series.
+    assert "src_node=n1" in tag_key and "dst_node=n2" in tag_key
+    assert "job=j" in tag_key
+    assert "rt_object_pull_ms" in entries
+    hits = entries["rt_job_locality_hits_total"]
+    misses = entries["rt_job_locality_misses_total"]
+    assert hits["by_tags"]["job=j"]["total"] == 6
+    assert misses["by_tags"]["job=j"]["total"] == 2
+
+
+def test_build_node_report_and_jobs_carry_per_job_spill_ops():
+    from ray_tpu._private.memory_ledger import build_node_report
+
+    report = build_node_report(
+        "n1",
+        [],
+        {"used": 0, "capacity": 1 << 30, "num_objects": 0},
+        job_spill_ops={"j": 3},
+        job_restore_ops={"j": 1},
+    )
+    assert report["job_spill_ops"] == {"j": 3}
+    assert report["job_restore_ops"] == {"j": 1}
+    led = _ledger()
+    led.fold(report)
+    jobs = led.jobs()
+    assert jobs["j"]["spill_ops"] == 3
+    assert jobs["j"]["restore_ops"] == 1
+    s = led.transfer_summary()
+    assert s["job_spill_ops"] == {"j": 3}
+    assert s["job_restore_ops"] == {"j": 1}
+
+
+def test_data_verdict_convicts_misplaced_task_only_with_capacity():
+    led = _ledger()
+    # 8 MB pulled remotely, 100% of the task's get bytes: over the
+    # 1 MB floor and the 0.5 miss threshold.
+    led.record_gets(
+        "j", "pull", "n9", "n1", "consume", 4, 8 * MB, ms=40.0
+    )
+    v = led.data_verdict(node_has_capacity=lambda node: True)
+    assert len(v["misplaced_tasks"]) == 1
+    row = v["misplaced_tasks"][0]
+    assert row["task"] == "consume"
+    assert row["src"] == "n9"
+    assert row["remote_fraction"] == 1.0
+    assert "consume" in row["detail"]
+    # Same evidence, but the copy-holding node was full: no conviction
+    # (the task could not have run there anyway).
+    v2 = led.data_verdict(node_has_capacity=lambda node: False)
+    assert v2["misplaced_tasks"] == []
+
+
+def test_data_verdict_classifies_pull_vs_restore_dominated():
+    led = _ledger()
+    led.record_transfer("pullers", "n1", "n2", "pull", 16 * MB, ms=9.0)
+    led.record_transfer("pagers", "n2", "n2", "restore", 8 * MB)
+    led.record_transfer("pagers", "n2", "n2", "restore", 8 * MB)
+    v = led.data_verdict()
+    assert v["jobs"]["pullers"]["classification"] == "pull_dominated"
+    assert v["jobs"]["pagers"]["classification"] == "restore_dominated"
+    # Hottest flow: the largest CROSS-node flow (restores are local).
+    assert v["hottest_flow"]["job"] == "pullers"
+    assert v["hottest_flow"]["src"] == "n1"
+
+
+def test_data_verdict_ignores_small_remote_pulls():
+    led = _ledger()
+    led.record_gets("j", "pull", "n9", "n1", "tiny", 50, 512 * 1024)
+    v = led.data_verdict(node_has_capacity=lambda node: True)
+    assert v["misplaced_tasks"] == []  # under the 1 MB evidence floor
+
+
+# -- single-node session end-to-end -----------------------------------
+
+
+def test_list_objects_gains_node_copies_source_columns(rt_session):
+    rt = rt_session
+    from ray_tpu.util import state
+
+    ref = rt.put(np.zeros(MB // 8, dtype=np.float64))  # 1 MB, not inline
+    assert rt.get(ref) is not None
+    rows = state.list_objects()
+    assert rows, "object table empty after a 1 MB put"
+    big = rows[0]  # size-descending: the put is the biggest thing here
+    assert {"node", "copies", "source"} <= set(big)
+    assert big["copies"] >= 1
+    assert big["source"] in ("local", "inline")
+    assert big["node"], "a sealed copy must name its holder node"
+
+
+def test_object_locations_index(rt_session):
+    rt = rt_session
+    from ray_tpu.util import state
+
+    ref = rt.put(np.ones(MB // 4, dtype=np.float64))  # 2 MB
+    assert float(rt.get(ref).sum()) == MB // 4
+    oid = ref.hex()
+    rows = state.object_locations(object_ids=[oid])
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["object_id"] == oid
+    assert row["size"] >= 2 * MB
+    assert row["nodes"], "the driver node holds the copy"
+    assert row["spilled"] is False
+    # Unfiltered: size-descending, our 2 MB object near the top.
+    all_rows = state.object_locations()
+    assert all_rows[0]["size"] >= all_rows[-1]["size"]
+    assert oid in {r["object_id"] for r in all_rows}
+
+
+def test_driver_get_provenance_reaches_transfer_summary(rt_session):
+    rt = rt_session
+    from ray_tpu.util import metrics, state
+
+    job = rt.get_runtime_context().get_job_id()
+    ref = rt.put(np.zeros(MB // 2, dtype=np.float64))  # 4 MB shm path
+    assert rt.get(ref) is not None
+    deadline = time.time() + 20
+    prov = {}
+    while time.time() < deadline:
+        metrics.flush()
+        prov = state.transfer_summary()["provenance"].get(job, {})
+        if prov.get("local", {}).get("bytes", 0) >= 4 * MB:
+            break
+        time.sleep(0.3)
+    assert prov.get("local", {}).get("bytes", 0) >= 4 * MB, prov
+    # Locality: a driver-local arena hit counts as a hit.
+    loc = state.transfer_summary()["locality"][job]
+    assert loc["hits"] >= 1
+
+
+def test_get_provenance_instrument_under_one_percent_of_smoke_step(
+    rt_session,
+):
+    """The hard bar from ISSUE 20: the per-get classify+fold must cost
+    <1% of a --smoke train step, measured against the same
+    conservative 20 ms floor the compile-watch and lock-witness bars
+    use, so the test doesn't flake under CI load."""
+    from ray_tpu._private.worker import global_worker
+
+    worker = global_worker()
+    n = 5000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            worker._record_get("local", "", 4096, 0.05)
+        best = min(best, (time.perf_counter() - t0) / n)
+    overhead_ms = best * 1e3
+    smoke_step_floor_ms = 20.0
+    assert overhead_ms < 0.01 * smoke_step_floor_ms, (
+        f"get-provenance instrument costs {overhead_ms:.4f} ms per "
+        f"get — over 1% of a {smoke_step_floor_ms} ms smoke step"
+    )
+
+
+def test_transfer_summary_reports_disabled_when_gated(rt_session):
+    """transfer_report_interval_s <= 0 turns the whole instrument off;
+    the summary says so instead of serving silently-empty tables."""
+    rt = rt_session
+    from ray_tpu.util import state
+
+    daemon = rt.api._session.daemon
+    old = daemon.config.transfer_report_interval_s
+    daemon.config.transfer_report_interval_s = 0.0
+    try:
+        assert state.transfer_summary().get("disabled") is True
+    finally:
+        daemon.config.transfer_report_interval_s = old
+    assert state.transfer_summary().get("disabled") is not True
+
+
+# -- two-node smoke + chaos (slow) -------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_two_node_matrix_prometheus_and_misplaced_doctor(tmp_path):
+    """CI smoke (satellite): producer pinned to the worker node,
+    consumer pinned to the head — every consume get crosses the wire.
+    The transfer matrix must account >=95% of the measured cross-node
+    bytes, the same flows must surface on /metrics and /api/transfers,
+    and `ray_tpu doctor --json` (a separate process, like an operator
+    would run it) must exit 1 naming the flow and the misplaced
+    consumer."""
+    import urllib.request
+
+    import ray_tpu as rt
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dashboard import start_dashboard
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_ADDRESS", None)
+
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"CPU": 2.0, "head_node": 4.0},
+        # Fast report/drain ticks so the matrix fills within the
+        # test's patience.
+        system_config={
+            "memory_report_interval_s": 0.2,
+            "transfer_report_interval_s": 0.1,
+        },
+    )
+    c.add_node(num_cpus=2, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+
+        @rt.remote(resources={"remote_node": 1.0})
+        def produce():
+            return np.arange(MB, dtype=np.uint64)  # 8 MB payload
+
+        @rt.remote(resources={"head_node": 1.0})
+        def consume(refs):
+            # Explicit get INSIDE the task: the get classifies under
+            # the task's name, which is what the misplacement verdict
+            # convicts.
+            return float(rt.get(refs[0]).sum())
+
+        total_payload = 0
+        for _ in range(3):
+            ref = produce.remote()
+            assert rt.get(consume.remote([ref]), timeout=120) > 0
+            total_payload += 8 * MB
+
+        from ray_tpu.util import metrics, state
+
+        deadline = time.time() + 60
+        cross_bytes, summary = 0, {}
+        while time.time() < deadline:
+            metrics.flush()
+            summary = state.transfer_summary()
+            cross_bytes = sum(
+                f["bytes"]
+                for f in summary["flows"]
+                if f["cross_node"]
+            )
+            tasks_seen = {
+                t["task"]
+                for t in summary["tasks"]
+                if t["remote_bytes"] >= 8 * MB
+            }
+            if (
+                cross_bytes >= int(0.95 * total_payload)
+                and "consume" in tasks_seen
+            ):
+                break
+            time.sleep(0.5)
+        # The >=95% accounting bar: every measured cross-node byte of
+        # the 3 x 8 MB payloads shows up in the matrix.
+        assert cross_bytes >= int(0.95 * total_payload), summary
+        top = max(
+            (f for f in summary["flows"] if f["cross_node"]),
+            key=lambda f: f["bytes"],
+        )
+        assert top["pulls"] >= 3
+
+        # Prometheus + dashboard surfaces serve the same matrix.
+        dash = start_dashboard(port=0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/metrics", timeout=30
+            ) as resp:
+                text = resp.read().decode()
+            assert (
+                "# TYPE rt_object_transfer_bytes_total counter" in text
+            )
+            assert 'src_node="' in text and 'dst_node="' in text
+            assert "rt_job_locality_misses_total" in text
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/transfers",
+                timeout=30,
+            ) as resp:
+                api = json.loads(resp.read().decode())
+            assert any(f["cross_node"] for f in api["flows"])
+            assert api["tasks"], api
+        finally:
+            dash.stop()
+
+        # The operator's view: doctor exits 1 and names the flow and
+        # the misplaced consumer (head had the pulls, the worker node
+        # had both the bytes and idle CPU).
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu",
+                "doctor",
+                "--json",
+                "--no-stacks",
+                "--address",
+                c.address,
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 1, out.stdout + out.stderr
+        verdict = json.loads(out.stdout)
+        data = verdict["data"]
+        assert data["hottest_flow"]["cross_node"] is True
+        misplaced = [
+            p
+            for p in verdict["problems"]
+            if p["kind"] == "misplaced_task"
+        ]
+        assert any(p["task"] == "consume" for p in misplaced), (
+            verdict["problems"]
+        )
+        assert any(
+            s["task"] == "consume" for s in data["misplaced_tasks"]
+        )
+    finally:
+        rt.shutdown()
+        c.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_kill_holder_mid_pull_counts_abort_never_bills_bytes():
+    """Chaos (satellite): the only copy-holder dies while the driver
+    node is pulling (chaos-dropped chunk RPCs hold the pull in its
+    retry loop across the kill). The get must error (nothing was
+    spilled, so no restore path exists), the aborted attempts must be
+    counted — rt_object_pulls_aborted_total and the flow's aborted
+    column — and the flow must bill ZERO transferred bytes: a dead
+    pull is never double-billed as moved data."""
+    import ray_tpu as rt
+    from ray_tpu._private.rpc import configure_chaos
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"CPU": 2.0},
+        system_config={
+            "memory_report_interval_s": 0.2,
+            "transfer_report_interval_s": 0.1,
+            # The native arenas of two same-host daemons take the
+            # mmap fast path, which never issues the chunk RPCs chaos
+            # targets; the py store forces the socket pull path a
+            # real cross-host cluster uses.
+            "use_native_object_store": False,
+        },
+    )
+    node = c.add_node(num_cpus=2, resources={"remote_node": 4.0})
+    c.wait_for_nodes(2)
+    rt.init(address=c.address)
+    try:
+
+        @rt.remote(resources={"remote_node": 1.0})
+        def produce():
+            return np.arange(MB, dtype=np.uint64)  # 8 MB, holder-only
+
+        ref = produce.remote()
+        # Wait via the head's location index, NOT rt.wait: wait()
+        # resolves the object locally, which would complete the pull
+        # before chaos is armed.
+        from ray_tpu.util import state
+
+        deadline = time.time() + 60
+        holders = []
+        while time.time() < deadline and not holders:
+            rows = state.object_locations(object_ids=[ref.hex()])
+            holders = rows[0]["nodes"] if rows else []
+            time.sleep(0.2)
+        assert holders, "producer never sealed its result"
+
+        # Drop every pull chunk: the pull dies mid-flight, retries,
+        # and keeps dying — the window in which we kill the holder.
+        # The budget must outlast the retry storm (each re-armed pull
+        # burns a window of chunk tokens, thousands per second).
+        configure_chaos("pull_object=100000000")
+        try:
+            with pytest.raises(Exception):
+                rt.get(ref, timeout=8)
+            c.remove_node(node)  # the only copy is gone for good
+            with pytest.raises(Exception):
+                rt.get(ref, timeout=8)
+        finally:
+            configure_chaos("")
+
+        from ray_tpu.util import metrics
+
+        deadline = time.time() + 30
+        aborted, flows = 0, []
+        while time.time() < deadline:
+            metrics.flush()
+            flows = state.transfer_summary()["flows"]
+            aborted = sum(f["aborted"] for f in flows)
+            if aborted >= 1:
+                break
+            time.sleep(0.5)
+        assert aborted >= 1, flows
+        # Never double-billed: no cross-node flow carries bytes (the
+        # payload never completed a pull).
+        assert all(
+            f["bytes"] == 0 for f in flows if f["cross_node"]
+        ), flows
+        summary = metrics.metrics_summary()
+        assert (
+            summary.get("rt_object_pulls_aborted_total", {}).get(
+                "total", 0
+            )
+            >= 1
+        ), {k: v for k, v in summary.items() if "abort" in k}
+    finally:
+        rt.shutdown()
+        c.shutdown()

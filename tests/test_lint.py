@@ -321,6 +321,57 @@ CASES = [
         """,
         False,
     ),
+    (
+        # The transfer-matrix cardinality contract (ISSUE 20): a
+        # fused src-dst pair label is N^2 series no PromQL
+        # aggregation can decompose; so is a per-pull flow id.
+        "RT010",
+        "user/transfer_metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter
+
+        transfers = Counter(
+            "my_transfer_bytes_total", tag_keys=("job", "flow")
+        )
+
+        def record(hist, src, dst, ms):
+            hist.observe(ms, tags={"src_dst": src + ":" + dst})
+        """,
+        True,
+    ),
+    (
+        "RT010",
+        "user/transfer_metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter
+
+        def record(counter, fid, nbytes):
+            counter.inc(nbytes, tags={"flow_id": fid})
+        """,
+        True,
+    ),
+    (
+        # ...while src_node / dst_node as SEPARATE labels are the
+        # sanctioned shape (node granularity is bounded; either side
+        # aggregates) — the shape of rt_object_transfer_bytes_total.
+        "RT010",
+        "user/transfer_metrics_mod.py",
+        """
+        from ray_tpu.util.metrics import Counter
+
+        transfers = Counter(
+            "my_transfer_bytes_total",
+            tag_keys=("job", "src_node", "dst_node"),
+        )
+
+        def record(counter, job, src, dst, nbytes):
+            counter.inc(
+                nbytes,
+                tags={"job": job, "src_node": src, "dst_node": dst},
+            )
+        """,
+        False,
+    ),
 ]
 
 
